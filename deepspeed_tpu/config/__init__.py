@@ -1,0 +1,15 @@
+from .config import (
+    DeepSpeedConfig,
+    DeepSpeedConfigError,
+    FP16Config,
+    BF16Config,
+    MeshConfig,
+    MonitorConfig,
+    FlopsProfilerConfig,
+    ActivationCheckpointingConfig,
+    CommsLoggerConfig,
+    PipelineConfig,
+    CheckpointConfig,
+    AIOConfig,
+)
+from .config_utils import ConfigModel

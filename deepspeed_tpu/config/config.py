@@ -1,0 +1,301 @@
+"""Top-level training config.
+
+TPU-native analogue of reference ``deepspeed/runtime/config.py`` (``DeepSpeedConfig`` at
+``config.py:738``, ``_initialize_params:845``, batch-triple inference ``_configure_train_batch_size``).
+Accepts the same JSON/dict surface (also a path to a ``.json`` file), resolves the
+(train_batch_size, micro_batch_per_device, gradient_accumulation_steps) triple against the
+data-parallel world size, and instantiates per-subsystem configs.
+
+TPU-native addition: a ``"mesh"`` block naming the device-mesh axis sizes
+(data/fsdp/tensor/pipe/expert/seq); -1 means "infer from device count".
+"""
+
+import base64
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from .. import constants as C
+from ..utils.logging import logger
+from .config_utils import ConfigModel
+from ..runtime.zero.config import DeepSpeedZeroConfig
+
+
+class FP16Config(ConfigModel):
+    """Reference ``runtime/fp16/...`` config block (``runtime/config.py`` fp16 keys)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0)     # 0 = dynamic
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, gt=0)
+    hysteresis: int = Field(2, ge=0)
+    min_loss_scale: float = Field(1.0, ge=0)
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+class BF16Config(ConfigModel):
+    enabled: bool = False
+
+
+class MeshConfig(ConfigModel):
+    """TPU-native parallelism spec: sizes of named mesh axes.
+
+    ``data`` is the pure data-parallel axis; ``fsdp`` is the axis ZeRO shards over (when ZeRO
+    stage > 0 and fsdp == 1 it absorbs the data axis — see ``parallel/mesh.py``); ``tensor`` is
+    megatron-style TP; ``pipe`` pipeline stages; ``expert`` MoE expert parallelism; ``seq``
+    sequence/context parallelism (ring attention), absent in the reference snapshot (SURVEY §2.3).
+    """
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+
+
+class GradientClippingConfig(ConfigModel):
+    enabled: bool = False
+    max_norm: float = 1.0
+
+
+class ActivationCheckpointingConfig(ConfigModel):
+    """Reference ``runtime/activation_checkpointing/config.py`` keys."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: jax.checkpoint policy name (see runtime/activation_checkpointing)
+    policy: str = "nothing_saveable"
+
+
+class CommsLoggerConfig(ConfigModel):
+    """Reference ``comm/config.py:CommsLoggerConfig``."""
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = Field(default_factory=list)
+
+
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(ConfigModel):
+    """Reference ``monitor/config.py``."""
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self) -> bool:
+        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+
+
+class FlopsProfilerConfig(ConfigModel):
+    """Reference ``profiling/config.py``."""
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class PipelineConfig(ConfigModel):
+    """Reference pipeline keys (``runtime/config.py`` "pipeline" block)."""
+    stages: str = "auto"
+    partition: str = "best"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    use_reentrant: bool = True
+
+
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    checkpoint_in_cpu: bool = False
+    async_save: bool = False
+
+
+class AIOConfig(ConfigModel):
+    """Reference ``runtime/swap_tensor/aio_config.py`` keys."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def _resolve_config_dict(config: Union[str, dict]) -> dict:
+    if isinstance(config, dict):
+        return dict(config)
+    if isinstance(config, str):
+        if os.path.exists(config):
+            with open(config) as f:
+                return json.load(f)
+        # reference accepts base64-encoded JSON (runtime/config.py:745)
+        try:
+            return json.loads(base64.urlsafe_b64decode(config).decode())
+        except Exception:
+            raise DeepSpeedConfigError(
+                f"Expected a file path, dict, or base64 JSON, got: {config!r}")
+    raise DeepSpeedConfigError(f"Unsupported config type: {type(config)}")
+
+
+class DeepSpeedConfig:
+    """Parsed, validated, batch-resolved training configuration.
+
+    Reference: ``runtime/config.py:738``. ``dp_world_size`` is the product of the data and fsdp
+    mesh axes (the axes a batch is split over).
+    """
+
+    def __init__(self, config: Union[str, dict], dp_world_size: Optional[int] = None):
+        self._param_dict = _resolve_config_dict(config)
+        pd = self._param_dict
+
+        for key in C.IGNORED_CUDA_ONLY_KEYS:
+            if key in pd:
+                logger.warning(f"Config key '{key}' is CUDA-specific and ignored on TPU")
+
+        # --- subsystem blocks -------------------------------------------------
+        self.mesh = MeshConfig(**pd.get(C.MESH, {}))
+        self.zero_config = DeepSpeedZeroConfig(**pd.get(C.ZERO_OPTIMIZATION, {}))
+        self.fp16 = FP16Config(**pd.get(C.FP16, {}))
+        bf16_dict = pd.get(C.BFLOAT16, pd.get(C.BFLOAT16_OLD, {}))
+        self.bf16 = BF16Config(**bf16_dict)
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **pd.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.comms_logger = CommsLoggerConfig(**pd.get(C.COMMS_LOGGER, {}))
+        self.monitor_config = MonitorConfig(
+            tensorboard=pd.get(C.MONITOR_TENSORBOARD, {}),
+            wandb=pd.get(C.MONITOR_WANDB, {}),
+            csv_monitor=pd.get(C.MONITOR_CSV, {}),
+        )
+        self.flops_profiler = FlopsProfilerConfig(**pd.get(C.FLOPS_PROFILER, {}))
+        self.pipeline = PipelineConfig(**pd.get(C.PIPELINE, {}))
+        self.checkpoint_config = CheckpointConfig(**pd.get(C.CHECKPOINT, {}))
+        self.aio_config = AIOConfig(**pd.get(C.AIO, {}))
+
+        # --- scalars ----------------------------------------------------------
+        self.optimizer_name: Optional[str] = None
+        self.optimizer_params: Dict[str, Any] = {}
+        if C.OPTIMIZER in pd:
+            self.optimizer_name = pd[C.OPTIMIZER].get("type")
+            if self.optimizer_name:
+                self.optimizer_name = self.optimizer_name.lower()
+            self.optimizer_params = pd[C.OPTIMIZER].get(C.OPTIMIZER_PARAMS, {})
+        self.scheduler_name: Optional[str] = None
+        self.scheduler_params: Dict[str, Any] = {}
+        if C.SCHEDULER in pd:
+            self.scheduler_name = pd[C.SCHEDULER].get("type")
+            self.scheduler_params = pd[C.SCHEDULER].get(C.SCHEDULER_PARAMS, {})
+
+        self.gradient_clipping: float = pd.get(C.GRADIENT_CLIPPING,
+                                               C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients: bool = pd.get(C.PRESCALE_GRADIENTS, False)
+        self.gradient_predivide_factor: float = pd.get(C.GRADIENT_PREDIVIDE_FACTOR, 1.0)
+        self.sparse_gradients_enabled: bool = pd.get(C.SPARSE_GRADIENTS, False)
+        self.steps_per_print: int = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.wall_clock_breakdown: bool = pd.get(C.WALL_CLOCK_BREAKDOWN, False)
+        self.memory_breakdown: bool = pd.get(C.MEMORY_BREAKDOWN, False)
+        self.dump_state: bool = pd.get(C.DUMP_STATE, False)
+        self.dataloader_drop_last: bool = pd.get(C.DATALOADER_DROP_LAST, False)
+        self.progressive_layer_drop: Dict = pd.get(C.PROGRESSIVE_LAYER_DROP, {})
+        self.eigenvalue: Dict = pd.get(C.EIGENVALUE, {})
+        self.elasticity: Dict = pd.get(C.ELASTICITY, {})
+        self.compression_config: Dict = pd.get(C.COMPRESSION_TRAINING, {})
+        self.data_efficiency_config: Dict = pd.get(C.DATA_EFFICIENCY, {})
+        self.curriculum_params_legacy: Dict = pd.get(C.CURRICULUM_LEARNING_LEGACY, {})
+        self.curriculum_enabled_legacy: bool = bool(
+            self.curriculum_params_legacy.get("enabled", False))
+
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+
+        # --- batch triple -----------------------------------------------------
+        self.train_batch_size: Optional[int] = pd.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu: Optional[int] = pd.get(
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps: Optional[int] = pd.get(
+            C.GRADIENT_ACCUMULATION_STEPS)
+        if dp_world_size is not None:
+            self.resolve_batch_config(dp_world_size)
+
+    # Batch-triple inference: reference ``runtime/config.py`` _configure_train_batch_size.
+    def resolve_batch_config(self, dp_world_size: int):
+        assert dp_world_size >= 1
+        self.dp_world_size = dp_world_size
+        tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                       self.gradient_accumulation_steps)
+        if tb is not None and mb is not None and gas is not None:
+            pass
+        elif tb is not None and mb is not None:
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None:
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None and gas is not None:
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            gas = 1
+            mb = tb // dp_world_size
+        elif mb is not None:
+            gas = 1
+            tb = mb * dp_world_size
+        else:
+            raise DeepSpeedConfigError(
+                "At least one of train_batch_size / train_micro_batch_size_per_gpu must be set")
+        self.train_batch_size, self.train_micro_batch_size_per_gpu, \
+            self.gradient_accumulation_steps = tb, mb, gas
+        self._batch_assertion()
+
+    def _batch_assertion(self):
+        tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                       self.gradient_accumulation_steps)
+        if tb <= 0 or mb <= 0 or gas <= 0:
+            raise DeepSpeedConfigError(
+                f"Batch sizes must be positive: train={tb} micro={mb} gas={gas}")
+        if tb != mb * gas * self.dp_world_size:
+            raise DeepSpeedConfigError(
+                f"Check batch-related parameters: train_batch_size ({tb}) != "
+                f"micro_batch_per_device ({mb}) * gradient_accumulation_steps ({gas}) * "
+                f"dp_world_size ({self.dp_world_size})")
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    def print_user_config(self):
+        logger.info(json.dumps(self._param_dict, sort_keys=True, indent=4,
+                               default=lambda o: str(o)))

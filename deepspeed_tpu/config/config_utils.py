@@ -1,0 +1,60 @@
+"""Config model base class.
+
+TPU-native analogue of the reference's ``deepspeed/runtime/config_utils.py``
+(``DeepSpeedConfigModel``): a pydantic model accepting the same JSON surface, with support for
+deprecated fields that forward their value to a replacement field.
+"""
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+
+class ConfigModel(BaseModel):
+    """Base for all subsystem configs.
+
+    Supports ``Field(..., json_schema_extra={"deprecated": True, "new_param": "name"})`` the way
+    the reference's ``DeepSpeedConfigModel`` does (``config_utils.py``): if the user sets the
+    deprecated field, its value is forwarded to the replacement and a warning is logged.
+    """
+
+    model_config = ConfigDict(
+        validate_assignment=True,
+        populate_by_name=True,
+        extra="allow",
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data: Any):
+        if not strict:  # drop None values so defaults apply, like the reference
+            data = {k: v for k, v in data.items() if v is not None or k.endswith("_")}
+        super().__init__(**data)
+
+    @model_validator(mode="after")
+    def _forward_deprecated_fields(self):
+        from ..utils.logging import logger
+        fields_set = self.model_fields_set
+        for name, field in type(self).model_fields.items():
+            extra = field.json_schema_extra or {}
+            if not isinstance(extra, dict) or not extra.get("deprecated"):
+                continue
+            if name in fields_set:
+                new_param = extra.get("new_param", "")
+                logger.warning(f"Config parameter {name} is deprecated" +
+                               (f", use {new_param} instead" if new_param else ""))
+                if new_param and new_param not in fields_set:
+                    object.__setattr__(self, new_param, getattr(self, name))
+        return self
+
+    def dict(self, **kwargs) -> Dict[str, Any]:  # pydantic-v1-style convenience
+        return self.model_dump(**kwargs)
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    """Reference ``runtime/config_utils.py:get_scalar_param``."""
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)

@@ -1,0 +1,102 @@
+"""Config key constants and defaults.
+
+Mirrors the JSON config surface of the reference (``deepspeed/runtime/constants.py``) so a
+DeepSpeed user's config file keys carry over; values that are CUDA-only are accepted and ignored
+with a warning rather than rejected.
+"""
+
+#############################################
+# Batch-size triple (reference runtime/constants.py TRAIN_BATCH_SIZE et al.)
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+#############################################
+# Optimizer / scheduler
+#############################################
+OPTIMIZER = "optimizer"
+OPTIMIZER_TYPE_DEFAULT = None
+OPTIMIZER_PARAMS = "params"
+SCHEDULER = "scheduler"
+SCHEDULER_TYPE_DEFAULT = None
+SCHEDULER_PARAMS = "params"
+MAX_GRAD_NORM = "max_grad_norm"
+
+#############################################
+# Precision
+#############################################
+FP16 = "fp16"
+FP16_ENABLED = "enabled"
+FP16_LOSS_SCALE = "loss_scale"
+FP16_INITIAL_SCALE_POWER = "initial_scale_power"
+FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
+FP16_HYSTERESIS = "hysteresis"
+FP16_MIN_LOSS_SCALE = "min_loss_scale"
+FP16_AUTO_CAST = "auto_cast"
+BFLOAT16 = "bf16"
+BFLOAT16_OLD = "bfloat16"
+BFLOAT16_ENABLED = "enabled"
+AMP = "amp"
+
+#############################################
+# Gradient clipping / misc training knobs
+#############################################
+GRADIENT_CLIPPING = "gradient_clipping"
+GRADIENT_CLIPPING_DEFAULT = 0.0
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+SPARSE_GRADIENTS = "sparse_gradients"
+STEPS_PER_PRINT = "steps_per_print"
+STEPS_PER_PRINT_DEFAULT = 10
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+DUMP_STATE = "dump_state"
+MEMORY_BREAKDOWN = "memory_breakdown"
+
+#############################################
+# ZeRO
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+
+#############################################
+# Parallelism (TPU-native addition: mesh axes in config)
+#############################################
+MESH = "mesh"  # {"data": -1, "fsdp": 1, "tensor": 1, "pipe": 1, "expert": 1, "seq": 1}
+
+#############################################
+# Subsystems
+#############################################
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+COMMS_LOGGER = "comms_logger"
+MONITOR_TENSORBOARD = "tensorboard"
+MONITOR_WANDB = "wandb"
+MONITOR_CSV = "csv_monitor"
+FLOPS_PROFILER = "flops_profiler"
+ELASTICITY = "elasticity"
+COMPRESSION_TRAINING = "compression_training"
+DATA_EFFICIENCY = "data_efficiency"
+CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
+PIPELINE = "pipeline"
+AUTOTUNING = "autotuning"
+AIO = "aio"
+DATALOADER_DROP_LAST = "dataloader_drop_last"
+
+#############################################
+# Checkpoint
+#############################################
+CHECKPOINT = "checkpoint"
+LOAD_UNIVERSAL_CHECKPOINT = "load_universal"
+USE_NODE_LOCAL_STORAGE_CHECKPOINT = "use_node_local_storage"
+
+#############################################
+# Routing for progressive layer drop / eigenvalue
+#############################################
+PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
+EIGENVALUE = "eigenvalue"
+
+# Keys that exist in DeepSpeed configs but are CUDA-specific; accepted + ignored with a warning.
+IGNORED_CUDA_ONLY_KEYS = (
+    "communication_data_type",
+    "disable_allgather",
+    "fp16_master_weights_and_gradients",
+)

@@ -1,0 +1,20 @@
+from .mesh import (
+    MeshSpec,
+    default_mesh,
+    get_global_mesh,
+    set_global_mesh,
+    AXIS_PIPE,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MESH_AXES,
+    BATCH_AXES,
+)
+from .topology import (
+    ProcessTopology,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+)

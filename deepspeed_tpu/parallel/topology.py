@@ -1,0 +1,166 @@
+"""Cartesian process/device topology — pure grid math.
+
+Behavioural equivalent of the reference's ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology:9``, ``PipeModelDataParallelTopology:243``, ``PipelineParallelGrid:249``).
+On TPU the mesh (parallel/mesh.py) is the live object; this class remains useful for checkpoint
+reshaping, launcher math, pipeline rank mapping, and tests — anywhere ranks must be mapped to
+named coordinates without devices present.
+"""
+
+from collections import namedtuple
+from itertools import product as _cartesian
+from typing import Dict, List
+
+
+class ProcessTopology:
+    """Maps n-dimensional grid coordinates <-> linear ranks.
+
+    Axes are ordered outer-first: the LAST axis varies fastest with rank (row-major), matching
+    the reference's behaviour.
+    """
+
+    def __init__(self, axes: List[str], dims: List[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping: Dict = {}
+        for coord in _cartesian(*[range(d) for d in self.dims]):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = len(self.mapping)
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}")
+        return self.mapping[self.ProcessCoord(**coord_kwargs)]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank: int, omit_axes=("data",), inner_sep="_", outer_sep="-") -> str:
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 0
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that differ only along ``axis`` (the axis 'communicators')."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for other_coord in _cartesian(*[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, other_coord))
+            ranks = [self.get_rank(**{axis: i}, **fixed) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        """Ranks whose coordinates match all given axis=value filters."""
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return sorted(r for c, r in self.mapping.items() if _match(c))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return sorted(r for c, r in self.mapping.items() if getattr(c, axis) == idx)
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Reference ``topology.py:PipeDataParallelTopology`` — hybrid pipeline+data."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """Reference ``topology.py:243`` — 3D pipeline/model/data grid."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Rank-group bookkeeping over a ProcessTopology (reference ``topology.py:249``).
+
+    Mesh-free: answers 'which global ranks form my pipe/data/model group', used by the pipeline
+    engine's p2p maps and by checkpoint reshaping.
+    """
+
+    def __init__(self, topology: ProcessTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+        self.data_parallel_size = max(1, topology.get_dim("data"))
+        self.pipe_parallel_size = max(1, topology.get_dim("pipe"))
+        self.model_parallel_size = max(1, topology.get_dim("model"))
+        self.slice_parallel_size = self.model_parallel_size
+        assert self.world_size == (self.data_parallel_size * self.pipe_parallel_size *
+                                   self.model_parallel_size)
+        coord = topology.get_coord(global_rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0) if "model" in topology.axes else 0
+
+    def get_stage_id(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_id(self) -> int:
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_rank(self) -> int:
+        return self.data_parallel_id
+
+    def get_model_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.pipe_parallel_size
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.data_parallel_size
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.model_parallel_size
+
+    def pipe_group(self) -> List[int]:
+        filt = {"data": self.data_parallel_id}
+        if "model" in self._topo.axes:
+            filt["model"] = self.model_parallel_id
+        return self._topo.filter_match(**filt)
+
+    def data_group(self) -> List[int]:
+        filt = {"pipe": self.stage_id}
+        if "model" in self._topo.axes:
+            filt["model"] = self.model_parallel_id
+        return self._topo.filter_match(**filt)
+
+    def stage_to_global(self, stage_id: int) -> int:
+        group = self.pipe_group()
+        return group[stage_id]
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.pipe_parallel_size - 1

@@ -1,0 +1,106 @@
+"""ZeRO configuration.
+
+TPU-native analogue of reference ``deepspeed/runtime/zero/config.py`` (``DeepSpeedZeroConfig``,
+``ZeroStageEnum`` at ``zero/config.py:70,79``) and ``zero/offload_config.py``.
+
+On TPU, ZeRO stages map onto sharding specifications over the combined ``data``×``fsdp`` mesh
+axes rather than autograd-hook machinery:
+
+- stage 0: params/grads/optimizer replicated over data axis (plain DP; XLA psums grads).
+- stage 1: optimizer state sharded over the data axis.
+- stage 2: + gradients stored sharded (XLA emits reduce-scatter instead of all-reduce).
+- stage 3: + parameters sharded (FSDP-style); XLA inserts just-in-time all-gathers which it
+  overlaps with compute — the analogue of the reference's prefetching param coordinator.
+
+Most tuning knobs of the reference (bucket sizes, prefetch counts, persistence thresholds) do
+not exist on TPU because XLA schedules the collectives; they are accepted and ignored so configs
+carry over.
+"""
+
+from enum import IntEnum
+from typing import Optional
+
+from pydantic import Field
+
+from ...config.config_utils import ConfigModel
+
+
+class ZeroStageEnum(IntEnum):
+    """Reference ``zero/config.py:70``."""
+    disabled = 0
+    optimizer_states = 1
+    gradients = 2
+    weights = 3
+    max_stage = 3
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(ConfigModel):
+    """Reference ``zero/offload_config.py:DeepSpeedZeroOffloadParamConfig``."""
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(int(1e8), ge=0)
+    max_in_cpu: int = Field(int(1e9), ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(ConfigModel):
+    """Reference ``zero/offload_config.py:DeepSpeedZeroOffloadOptimizerConfig``."""
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+    @property
+    def pipeline(self) -> bool:
+        return self.pipeline_read or self.pipeline_write
+
+
+class DeepSpeedZeroConfig(ConfigModel):
+    """Reference ``zero/config.py:79`` — same JSON keys under ``"zero_optimization"``."""
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True          # ignored: XLA owns layout
+    reduce_scatter: bool = True                # implied by stage>=2 sharding on TPU
+    reduce_bucket_size: int = Field(int(5e8), ge=0)   # ignored: XLA buckets collectives
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None        # XLA latency-hiding scheduler handles overlap
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = Field(int(1e9), ge=0)
+    cpu_offload_param: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_param"})
+    cpu_offload_use_pin_memory: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True})
+    cpu_offload: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_optimizer"})
+    prefetch_bucket_size: int = Field(int(5e7), ge=0, alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(int(1e5), ge=0,
+                                             alias="stage3_param_persistence_threshold")
+    model_persistence_threshold: int = Field(int(1e15), ge=0,
+                                             alias="stage3_model_persistence_threshold")
+    max_live_parameters: int = Field(int(1e9), ge=0, alias="stage3_max_live_parameters")
+    max_reuse_distance: int = Field(int(1e9), ge=0, alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(
+        False, alias="stage3_gather_16bit_weights_on_model_save")
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False        # ignored: no flat-buffer partitioning on TPU
+
+    def __init__(self, **data):
+        if data.get("cpu_offload"):
+            data.setdefault("offload_optimizer", {"device": "cpu"})
+        if data.get("cpu_offload_param"):
+            data.setdefault("offload_param", {"device": "cpu"})
+        super().__init__(**data)

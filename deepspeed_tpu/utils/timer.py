@@ -1,0 +1,196 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analogue of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` / ``ThroughputTimer``). Synchronisation is
+``jax.block_until_ready`` on a sentinel instead of ``cuda.synchronize``; everything else is
+framework-neutral timing logic.
+"""
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from .logging import logger
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync():
+    """Block until all dispatched device work completes (analogue of cuda.synchronize)."""
+    try:
+        import jax
+        # effects_barrier waits for all outstanding async dispatches.
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._elapsed = 0.0
+        self._start_time = 0.0
+        self._record = []
+
+    def start(self, sync: bool = False):
+        if self.started:
+            return
+        if sync:
+            _sync()
+        self._start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, sync: bool = True, record: bool = False):
+        if not self.started:
+            return
+        if sync:
+            _sync()
+        dt = time.perf_counter() - self._start_time
+        self._elapsed += dt
+        if record:
+            self._record.append(dt)
+        self.started = False
+
+    def reset(self):
+        self.started = False
+        self._elapsed = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Elapsed time in seconds."""
+        was_started = self.started
+        if was_started:
+            self.stop()
+        out = self._elapsed
+        if reset:
+            self.reset()
+        if was_started:
+            self.start()
+        return out
+
+    def mean(self) -> float:
+        return sum(self._record) / len(self._record) if self._record else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry. Reference: ``utils/timer.py:SynchronizedWallClockTimer``."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = OrderedDict()
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"mem in_use={in_use:.2f}GB peak={peak:.2f}GB"
+        except Exception:
+            return "mem n/a"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        msg = "time (ms) | " + " | ".join(parts)
+        if memory_breakdown:
+            msg += " | " + self.memory_usage()
+        logger.info(msg)
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS estimation across steps.
+
+    Reference: ``utils/timer.py:ThroughputTimer``. ``batch_size`` here is the global train batch.
+    """
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        # optional: flops per sample for TFLOPS reporting
+        self.flops_per_sample: Optional[float] = None
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _sync()
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _sync()
+            self.end_time = time.perf_counter()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            self.start_time = 0.0
+            if global_step and report_speed and \
+                    self.global_step_count % self.steps_per_output == 0:
+                msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                       f"global_step={self.global_step_count}, "
+                       f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                       f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
+                if self.flops_per_sample:
+                    tflops = (self.flops_per_sample * self.batch_size /
+                              self.step_elapsed_time) / 1e12
+                    msg += f", TFLOPS={tflops:.2f}"
+                if self.monitor_memory:
+                    msg += ", " + SynchronizedWallClockTimer.memory_usage()
+                self.logging(msg)
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return 0.0

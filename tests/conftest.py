@@ -1,0 +1,37 @@
+"""Test harness configuration.
+
+The analogue of the reference's ``tests/unit/common.py`` distributed harness: where DeepSpeed
+spawns N torch.multiprocessing workers with real NCCL over localhost (``common.py:87
+DistributedExec``), the TPU framework runs multi-device tests single-process on a virtual
+8-device CPU mesh (``xla_force_host_platform_device_count``) — XLA's deterministic compilation
+makes this a faithful stand-in for sharding/collective semantics (SURVEY §4 'Implication').
+"""
+
+import os
+
+# XLA_FLAGS must be set before the CPU backend initialises (jax may already be imported by
+# site hooks, but backends initialise lazily, so this still takes effect).
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Site hooks may have imported jax with another platform pinned; override explicitly.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture
+def tmp_ckpt_dir(tmp_path):
+    return str(tmp_path / "ckpt")

@@ -1,0 +1,109 @@
+"""Config-system tests — analogue of reference ``tests/unit/runtime/test_ds_config_dict.py`` /
+``test_ds_config_model.py``."""
+
+import base64
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triple_full():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 2}, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 2
+
+
+@pytest.mark.parametrize("given,expected", [
+    ({"train_batch_size": 32}, (32, 4, 1)),
+    ({"train_micro_batch_size_per_gpu": 4}, (32, 4, 1)),
+    ({"train_batch_size": 32, "gradient_accumulation_steps": 2}, (32, 2, 2)),
+    ({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 4}, (64, 2, 4)),
+])
+def test_batch_triple_inference(given, expected):
+    cfg = DeepSpeedConfig(given, dp_world_size=8)
+    assert (cfg.train_batch_size, cfg.train_micro_batch_size_per_gpu,
+            cfg.gradient_accumulation_steps) == expected
+
+
+def test_batch_triple_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2}, dp_world_size=8)
+
+
+def test_batch_none_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, dp_world_size=8)
+
+
+def test_fp16_and_bf16_conflict():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, dp_world_size=1)
+
+
+def test_zero_config_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=1)
+    assert cfg.zero_config.stage == 0
+    assert not cfg.zero_enabled
+
+
+def test_zero_stage3_aliases():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_prefetch_bucket_size": 1000,
+            "stage3_param_persistence_threshold": 10,
+        },
+    }, dp_world_size=1)
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.prefetch_bucket_size == 1000
+    assert cfg.zero_config.param_persistence_threshold == 10
+
+
+def test_zero_deprecated_cpu_offload():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 2, "cpu_offload": True}},
+                          dp_world_size=1)
+    assert cfg.zero_config.offload_optimizer is not None
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_config_from_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16, "fp16": {"enabled": True}}))
+    cfg = DeepSpeedConfig(str(p), dp_world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.fp16.enabled
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_config_from_base64():
+    blob = base64.urlsafe_b64encode(
+        json.dumps({"train_batch_size": 8}).encode()).decode()
+    cfg = DeepSpeedConfig(blob, dp_world_size=1)
+    assert cfg.train_batch_size == 8
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, dp_world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 1e-3
+    assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_mesh_block():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "mesh": {"tensor": 2, "pipe": 2}}, dp_world_size=2)
+    assert cfg.mesh.tensor == 2
+    assert cfg.mesh.pipe == 2
+    assert cfg.mesh.data == -1

@@ -1,0 +1,59 @@
+"""Mesh construction tests (8 virtual CPU devices)."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.config.config import MeshConfig
+from deepspeed_tpu.parallel import MeshSpec, default_mesh
+from deepspeed_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_TENSOR
+
+
+def test_default_mesh_all_data(eight_devices):
+    spec = default_mesh(eight_devices)
+    assert spec.size(AXIS_DATA) == 8
+    assert spec.dp_world_size == 8
+    assert spec.n_devices == 8
+
+
+def test_mesh_infer_data(eight_devices):
+    spec = MeshSpec({AXIS_DATA: -1, AXIS_TENSOR: 2}, eight_devices)
+    assert spec.size(AXIS_DATA) == 4
+    assert spec.size(AXIS_TENSOR) == 2
+
+
+def test_mesh_bad_sizes(eight_devices):
+    with pytest.raises(ValueError):
+        MeshSpec({AXIS_DATA: 3, AXIS_TENSOR: 2}, eight_devices)
+
+
+def test_mesh_from_config_zero_folds_data_into_fsdp(eight_devices):
+    cfg = MeshConfig()
+    spec = MeshSpec.from_config(cfg, eight_devices, zero_stage=3)
+    assert spec.size(AXIS_FSDP) == 8
+    assert spec.size(AXIS_DATA) == 1
+    assert spec.dp_world_size == 8
+
+
+def test_mesh_from_config_no_zero(eight_devices):
+    spec = MeshSpec.from_config(MeshConfig(), eight_devices, zero_stage=0)
+    assert spec.size(AXIS_DATA) == 8
+    assert spec.size(AXIS_FSDP) == 1
+
+
+def test_batch_sharding_placement(eight_devices):
+    import jax.numpy as jnp
+    spec = default_mesh(eight_devices)
+    x = jnp.zeros((16, 4))
+    xs = jax.device_put(x, spec.batch_sharding(extra_dims=1))
+    assert len(xs.sharding.device_set) == 8
+    # each shard holds 16/8 = 2 rows
+    assert xs.addressable_shards[0].data.shape == (2, 4)
+
+
+def test_reference_api_shims(eight_devices):
+    spec = MeshSpec({AXIS_DATA: 2, AXIS_TENSOR: 2, "pipe": 2}, eight_devices)
+    assert spec.get_data_parallel_world_size() == 2
+    assert spec.get_model_parallel_world_size() == 2
+    assert spec.get_pipe_parallel_world_size() == 2
+    assert spec.get_sequence_parallel_world_size() == 1

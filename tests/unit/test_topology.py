@@ -1,0 +1,81 @@
+"""Topology grid-math tests — analogue of reference ``tests/unit/runtime/pipe/test_topology.py``
+(pure logic, no devices)."""
+
+import pytest
+
+from deepspeed_tpu.parallel import (
+    ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_coord_roundtrip():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    for rank in range(topo.world_size()):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(**coord._asdict()) == rank
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert len(pipe_lists) == 4
+    for lst in pipe_lists:
+        assert len(lst) == 2
+    data_lists = topo.get_axis_comm_lists("data")
+    assert len(data_lists) == 2
+    covered = sorted(r for lst in pipe_lists for r in lst)
+    assert covered == list(range(8))
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+    assert all(topo.get_coord(r).pipe == 0 for r in ranks)
+
+
+def test_topology_axis_list():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    assert topo.get_axis_list("pipe", 0) == [0, 1]
+    assert topo.get_axis_list("pipe", 1) == [2, 3]
+
+
+def test_grid_basic():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=3)
+    assert grid.pipe_parallel_size == 4
+    assert grid.data_parallel_size == 2
+    coord = topo.get_coord(3)
+    assert grid.get_stage_id() == coord.pipe
+    assert grid.get_data_parallel_id() == coord.data
+
+
+def test_grid_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=0)
+    pg = grid.pipe_group()
+    assert len(pg) == 2
+    assert grid.stage_to_global(0) == pg[0]
+    assert grid.stage_to_global(1) == pg[1]
+
+
+def test_grid_first_last_stage():
+    topo = PipeDataParallelTopology(num_pp=3, num_dp=1)
+    assert PipelineParallelGrid(topo, 0).is_first_stage()
+    assert PipelineParallelGrid(topo, 2).is_last_stage()
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    s = topo.get_rank_repr(0)
+    assert "pipe_00" in s and "model_00" in s and "data" not in s
