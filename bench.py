@@ -1,0 +1,80 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Runs the BASELINE config-1 workload shape on whatever chip is attached: GPT-2 125M causal-LM
+training, ZeRO stage 1, bf16, fused train step. Metric: training throughput in tokens/sec/chip.
+``vs_baseline`` is 1.0-relative once a reference number exists; ``BASELINE.json`` ``published``
+is empty for TPU configs, so we report the ratio against the first recorded value of this same
+bench (stored in ``.bench_baseline.json`` on first successful run).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, gpt2_model
+
+    import jax
+
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    micro = int(os.environ.get("BENCH_MICRO", 8))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    warmup = 3
+
+    n_chips = jax.device_count()
+    cfg = GPT2Config(vocab_size=50304,  # padded to 128 multiple for MXU tiling
+                     n_positions=seq, n_embd=768, n_layer=12, n_head=12,
+                     dropout=0.0, remat=True, scan_layers=True)
+    model = gpt2_model(cfg, sample_seq_len=seq)
+    config = {
+        "train_batch_size": micro * n_chips,
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 2e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 50304, size=(micro * n_chips, seq),
+                                       dtype=np.int32)}
+    for _ in range(warmup):
+        engine.train_batch(batch)
+    jax.effects_barrier()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    jax.effects_barrier()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec_per_chip = micro * n_chips * seq * steps / dt / n_chips
+    baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 ".bench_baseline.json")
+    vs_baseline = 1.0
+    try:
+        if os.path.exists(baseline_file):
+            with open(baseline_file) as f:
+                vs_baseline = tokens_per_sec_per_chip / json.load(f)["value"]
+        else:
+            with open(baseline_file, "w") as f:
+                json.dump({"value": tokens_per_sec_per_chip}, f)
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "gpt2_125m_zero1_bf16_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,9 +30,13 @@ from ..utils.comms_logging import CommsLogger
 from ..utils.logging import logger
 
 # Re-exports: the in-graph collective vocabulary (use inside shard_map/jit over mesh axes).
+# all_gather / all_to_all are prefixed lax_ because the eager host-side functions below own
+# the reference's names.
 from jax.lax import (  # noqa: F401
-    psum, pmean, pmax, pmin, all_gather, ppermute, all_to_all, axis_index, psum_scatter,
+    psum, pmean, pmax, pmin, ppermute, axis_index, psum_scatter,
 )
+from jax.lax import all_gather as lax_all_gather  # noqa: F401
+from jax.lax import all_to_all as lax_all_to_all  # noqa: F401
 
 comms_logger = CommsLogger()
 
@@ -76,8 +80,19 @@ def init_distributed(dist_backend: Optional[str] = None,
         # MPI launch without explicit env: reference comm.py:mpi_discovery equivalent.
         n_proc = int(os.environ["OMPI_COMM_WORLD_SIZE"])
         pid = int(os.environ["OMPI_COMM_WORLD_RANK"])
-        master = os.environ.get("MASTER_ADDR", "127.0.0.1")
-        coord = f"{master}:{distributed_port}"
+    if coord is None and n_proc > 1:
+        # torchrun-style env (MASTER_ADDR) or explicit init_method — derive the coordinator
+        # rather than silently running n_proc independent single-process worlds.
+        if init_method and init_method.startswith("tcp://"):
+            coord = init_method[len("tcp://"):]
+        elif "MASTER_ADDR" in os.environ:
+            port = os.environ.get("MASTER_PORT", str(distributed_port))
+            coord = f"{os.environ['MASTER_ADDR']}:{port}"
+        else:
+            raise RuntimeError(
+                f"init_distributed: world_size={n_proc} requested but no coordinator "
+                "address found (set COORDINATOR_ADDRESS or MASTER_ADDR, or pass "
+                "init_method='tcp://host:port')")
     if coord is not None and n_proc > 1:
         if verbose:
             logger.info(f"Initializing jax.distributed: coordinator={coord} "
@@ -177,8 +192,11 @@ def broadcast(host_array, src: int = 0):
     if get_world_size() == 1:
         return x
     from jax.experimental import multihost_utils
-    gathered = np.asarray(multihost_utils.process_allgather(x))
-    return gathered[src]
+    if src != 0:
+        # broadcast_one_to_all sources from process 0; rotate the payload there first
+        x = np.asarray(multihost_utils.process_allgather(x))[src]
+        return x
+    return np.asarray(multihost_utils.broadcast_one_to_all(x))
 
 
 @_timed("barrier")

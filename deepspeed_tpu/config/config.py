@@ -58,11 +58,6 @@ class MeshConfig(ConfigModel):
     seq: int = 1
 
 
-class GradientClippingConfig(ConfigModel):
-    enabled: bool = False
-    max_norm: float = 1.0
-
-
 class ActivationCheckpointingConfig(ConfigModel):
     """Reference ``runtime/activation_checkpointing/config.py`` keys."""
     partition_activations: bool = False

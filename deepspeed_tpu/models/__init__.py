@@ -1,0 +1,3 @@
+from .base import Model, from_flax
+from .gpt2 import (GPT2, GPT2Config, GPT2_PRESETS, cross_entropy_loss, gpt2_config,
+                   gpt2_model, gpt2_param_specs)

@@ -1,0 +1,67 @@
+"""Model contract for the training engine.
+
+The reference engine wraps a ``torch.nn.Module`` whose ``forward`` returns the loss
+(``runtime/engine.py:1781``). The JAX engine needs the functional equivalent: a pure
+``loss_fn(params, batch, rng) -> loss`` plus a parameter initialiser. :class:`Model` bundles
+those, with optional metadata the engine exploits:
+
+- ``param_specs``: pytree of PartitionSpec declaring tensor/pipeline sharding of parameters
+  (merged with ZeRO's fsdp sharding by ``runtime/zero/partition.py``).
+- ``apply_fn``: inference forward (logits), used by the inference engine.
+- ``flops_per_sample``: fed to the throughput timer / flops profiler.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class Model:
+    loss_fn: Callable  # (params, batch, rng) -> loss  or  (loss, aux)
+    init_fn: Callable  # (rng) -> params
+    apply_fn: Optional[Callable] = None  # (params, batch, rng) -> outputs
+    param_specs: Any = None
+    flops_per_sample: Optional[float] = None
+    name: str = "model"
+
+    def init(self, rng):
+        return self.init_fn(rng)
+
+
+def from_flax(module, sample_batch, loss_fn: Optional[Callable] = None,
+              rng_collections=("dropout",), name: Optional[str] = None,
+              param_specs: Any = None, flops_per_sample: Optional[float] = None) -> Model:
+    """Adapt a ``flax.linen`` module to :class:`Model`.
+
+    By default the module's ``__call__(batch, ...)`` must return the scalar loss (mirroring the
+    reference's nn.Module contract); pass ``loss_fn(logits_or_outputs, batch)`` to compute loss
+    from outputs instead.
+    """
+    import jax
+
+    def init_fn(rng):
+        init_rngs = {"params": rng}
+        for c in rng_collections:
+            rng, sub = jax.random.split(rng)
+            init_rngs[c] = sub
+        return module.init(init_rngs, sample_batch)["params"]
+
+    def full_loss(params, batch, rng):
+        rngs = {}
+        for i, c in enumerate(rng_collections):
+            rngs[c] = jax.random.fold_in(rng, i)
+        out = module.apply({"params": params}, batch, rngs=rngs)
+        if loss_fn is not None:
+            return loss_fn(out, batch)
+        return out
+
+    def apply_fn(params, batch, rng=None):
+        rngs = {}
+        if rng is not None:
+            for i, c in enumerate(rng_collections):
+                rngs[c] = jax.random.fold_in(rng, i)
+        return module.apply({"params": params}, batch, rngs=rngs)
+
+    return Model(loss_fn=full_loss, init_fn=init_fn, apply_fn=apply_fn,
+                 param_specs=param_specs, flops_per_sample=flops_per_sample,
+                 name=name or type(module).__name__)

@@ -1,0 +1,219 @@
+"""GPT-2 family — flagship causal-LM model.
+
+TPU-first re-design of the model class the reference optimises (Megatron GPT-2 is DeepSpeed's
+canonical workload; see reference ``tests/model/Megatron_GPT2`` and the inference containers
+``module_inject/containers/gpt2.py``). Design choices for XLA/TPU:
+
+- ``nn.scan`` over a single Block definition: one compiled layer body regardless of depth,
+  which keeps compile time flat and later gives pipeline stages a natural split point.
+- optional ``jax.checkpoint`` (remat) per layer — the analogue of the reference's activation
+  checkpointing (``runtime/activation_checkpointing/checkpointing.py``).
+- bf16 compute / fp32 params via the engine's dtype policy; softmax and layernorm run fp32.
+- attention is pluggable (``ops/transformer/attention.py``): xla | flash (Pallas) | ring
+  (sequence-parallel Pallas).
+- weight-tied LM head (wte used for output projection), GPT-2 initialisation scheme.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.transformer.attention import get_attention_impl
+from .base import Model
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16          # compute dtype
+    remat: bool = False
+    scan_layers: bool = True
+    attention_impl: str = "xla"
+    init_std: float = 0.02
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    def flops_per_token(self) -> float:
+        # 6ND training-flops rule + attention quadratic term
+        n = self.num_params()
+        return 6.0 * n + 12.0 * self.n_layer * self.n_embd * self.n_positions
+
+    def num_params(self) -> int:
+        d, L, v, t = self.n_embd, self.n_layer, self.vocab_size, self.n_positions
+        return v * d + t * d + L * (12 * d * d + 13 * d) + 2 * d
+
+
+# Preset sizes used by BASELINE configs (125M..13B follow GPT-3 table).
+GPT2_PRESETS = {
+    "gpt2-125m": dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-350m": dict(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-760m": dict(n_embd=1536, n_layer=24, n_head=16),
+    "gpt2-1.3b": dict(n_embd=2048, n_layer=24, n_head=16),
+    "gpt2-2.7b": dict(n_embd=2560, n_layer=32, n_head=32),
+    "gpt2-6.7b": dict(n_embd=4096, n_layer=32, n_head=32),
+    "gpt2-13b": dict(n_embd=5120, n_layer=40, n_head=40),
+}
+
+
+def gpt2_config(preset: str, **overrides) -> GPT2Config:
+    kw = dict(GPT2_PRESETS[preset])
+    kw.update(overrides)
+    return GPT2Config(**kw)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        attn = get_attention_impl(cfg.attention_impl)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn",
+                       kernel_init=nn.initializers.normal(cfg.init_std))(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, t, _ = q.shape
+        q = q.reshape(b, t, cfg.n_head, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_head, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_head, cfg.head_dim)
+        drop_rng = (None if deterministic or cfg.dropout == 0.0
+                    else self.make_rng("dropout"))
+        o = attn(q, k, v, causal=True, dropout_rate=0.0 if deterministic else cfg.dropout,
+                 dropout_rng=drop_rng)
+        o = o.reshape(b, t, cfg.n_embd)
+        # scaled init on residual-writing projections (GPT-2 scheme)
+        proj_init = nn.initializers.normal(cfg.init_std / (2 * cfg.n_layer) ** 0.5)
+        o = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj", kernel_init=proj_init)(o)
+        o = nn.Dropout(cfg.dropout, deterministic=deterministic)(o)
+        x = x + o
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(cfg.dtype)
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="c_fc",
+                     kernel_init=nn.initializers.normal(cfg.init_std))(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="mlp_c_proj",
+                     kernel_init=proj_init)(h)
+        h = nn.Dropout(cfg.dropout, deterministic=deterministic)(h)
+        return x + h
+
+
+class GPT2(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.config
+        b, t = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(cfg.init_std),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(cfg.init_std),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        x = wte[input_ids].astype(cfg.dtype) + wpe[:t][None].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False, static_argnums=(2,))
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, deterministic), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(cfg, name="h"), x, None)
+        else:
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = x.astype(jnp.float32) @ wte.T  # tied LM head, fp32 logits
+        return logits
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    """Next-token CE in fp32 with label masking."""
+    vocab = logits.shape[-1]
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    return nll.sum() / jnp.maximum(mask.sum(), 1)
+
+
+def gpt2_model(config: GPT2Config, sample_seq_len: Optional[int] = None,
+               sample_batch_size: int = 1) -> Model:
+    """Build a :class:`Model` for the engine: batch = {"input_ids": (B, T)} with optional
+    "labels" (defaults to shifted input_ids)."""
+    module = GPT2(config)
+    t = sample_seq_len or config.n_positions
+
+    def init_fn(rng):
+        sample = jnp.zeros((sample_batch_size, t), dtype=jnp.int32)
+        return module.init({"params": rng, "dropout": rng}, sample)["params"]
+
+    def _shift_labels(batch):
+        ids = batch["input_ids"]
+        if "labels" in batch:
+            return batch["labels"]
+        return jnp.concatenate(
+            [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, dtype=ids.dtype)], axis=1)
+
+    def loss_fn(params, batch, rng):
+        logits = module.apply({"params": params}, batch["input_ids"],
+                              deterministic=False, rngs={"dropout": rng})
+        return cross_entropy_loss(logits, _shift_labels(batch))
+
+    def apply_fn(params, batch, rng=None):
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return module.apply({"params": params}, ids, deterministic=True)
+
+    return Model(
+        loss_fn=loss_fn,
+        init_fn=init_fn,
+        apply_fn=apply_fn,
+        param_specs=None,  # filled per-mesh by gpt2_param_specs
+        flops_per_sample=config.flops_per_token() * t,
+        name=f"GPT2(L{config.n_layer},d{config.n_embd})",
+    )
+
+
+def gpt2_param_specs(params, tensor_axis: str = "tensor") -> Any:
+    """Megatron-style TP PartitionSpecs by parameter path.
+
+    Column-parallel: qkv and mlp-in kernels shard their output dim; row-parallel: attn/mlp out
+    projections shard their input dim; embeddings shard the vocab dim. XLA inserts the
+    all-reduces the reference does manually via ``LinearAllreduce`` (``module_inject/layers.py``).
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+
+    def spec_for(path_str: str, ndim: int):
+        lead = [None] * (ndim - 2)
+        if "c_attn" in path_str or "c_fc" in path_str:
+            if path_str.endswith("kernel"):
+                return P(*lead, None, tensor_axis)
+            return P(*([None] * (ndim - 1)), tensor_axis)
+        if ("c_proj" in path_str or "mlp_c_proj" in path_str) and path_str.endswith("kernel"):
+            return P(*lead, tensor_axis, None)
+        if path_str.endswith("wte"):
+            return P(tensor_axis, None)
+        return P(*([None] * ndim)) if ndim else P()
+
+    specs = []
+    for path, leaf in flat:
+        path_str = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        specs.append(spec_for(path_str, getattr(leaf, "ndim", 0)))
+    return jax.tree_util.tree_unflatten(treedef, specs)

@@ -1,0 +1,4 @@
+from .optimizer import Optimizer, from_optax
+from .adam.fused_adam import fused_adam, fused_adamw
+from .lamb.fused_lamb import fused_lamb
+from .adagrad.cpu_adagrad import adagrad

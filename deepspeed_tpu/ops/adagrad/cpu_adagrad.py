@@ -1,0 +1,42 @@
+"""Adagrad.
+
+Behavioural equivalent of reference ``deepspeed/ops/adagrad/cpu_adagrad.py``
+(``DeepSpeedCPUAdagrad``, AVX kernel ``csrc/adagrad/cpu_adagrad.cpp``). The host-offloaded
+variant lives with the ZeRO offload tier; this is the device-side math.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer import Optimizer
+
+
+class AdagradState(NamedTuple):
+    step: jnp.ndarray
+    sum_sq: any
+
+
+def adagrad(eps: float = 1e-10, weight_decay: float = 0.0) -> Optimizer:
+    def init(params):
+        return AdagradState(
+            step=jnp.int32(0),
+            sum_sq=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, dtype=jnp.float32), params))
+
+    def update(grads, state: AdagradState, params, lr):
+        def upd(p, g, s):
+            g = g.astype(jnp.float32)
+            if weight_decay != 0.0:
+                g = g + weight_decay * p.astype(jnp.float32)
+            s_new = s + g * g
+            return (p - lr * g / (jnp.sqrt(s_new) + eps)).astype(p.dtype), s_new
+
+        out = jax.tree_util.tree_map(upd, params, grads, state.sum_sq)
+        leaf = lambda t: isinstance(t, tuple)
+        return (jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=leaf),
+                AdagradState(step=state.step + 1,
+                             sum_sq=jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=leaf)))
+
+    return Optimizer(init=init, update=update, name="Adagrad")

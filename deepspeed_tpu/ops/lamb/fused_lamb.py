@@ -1,0 +1,66 @@
+"""LAMB optimizer.
+
+Behavioural equivalent of reference ``deepspeed/ops/lamb/fused_lamb.py`` (``FusedLamb``, CUDA
+kernel ``csrc/lamb/fused_lamb_cuda_kernel.cu``): Adam update rescaled per tensor by the trust
+ratio ||p|| / ||update||, with configurable min/max coefficient clamping.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer import Optimizer
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+
+
+def fused_lamb(betas: Tuple[float, float] = (0.9, 0.999),
+               eps: float = 1e-8,
+               weight_decay: float = 0.0,
+               bias_correction: bool = True,
+               max_coeff: float = 10.0,
+               min_coeff: float = 0.01) -> Optimizer:
+    """Defaults follow ``ops/lamb/fused_lamb.py:FusedLamb.__init__`` (max_coeff/min_coeff)."""
+    beta1, beta2 = betas
+
+    def init(params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        return LambState(step=jnp.int32(0),
+                         exp_avg=jax.tree_util.tree_map(zeros, params),
+                         exp_avg_sq=jax.tree_util.tree_map(zeros, params))
+
+    def update(grads, state: LambState, params, lr):
+        step = state.step + 1
+        if bias_correction:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m_new = beta1 * m + (1.0 - beta1) * g
+            v_new = beta2 * v + (1.0 - beta2) * (g * g)
+            u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay != 0.0:
+                u = u + weight_decay * p.astype(jnp.float32)
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            trust = jnp.where(u_norm > 0, p_norm / u_norm, 1.0)
+            trust = jnp.where(p_norm > 0, trust, 1.0)
+            trust = jnp.clip(trust, min_coeff, max_coeff)
+            return (p - lr * trust * u).astype(p.dtype), m_new, v_new
+
+        out = jax.tree_util.tree_map(upd, params, grads, state.exp_avg, state.exp_avg_sq)
+        leaf = lambda t: isinstance(t, tuple)
+        return (jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=leaf),
+                LambState(step=step,
+                          exp_avg=jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=leaf),
+                          exp_avg_sq=jax.tree_util.tree_map(lambda t: t[2], out, is_leaf=leaf)))
+
+    return Optimizer(init=init, update=update, name="FusedLamb")

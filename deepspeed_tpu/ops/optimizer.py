@@ -1,0 +1,34 @@
+"""Optimizer protocol.
+
+The reference ships CUDA/AVX 'fused' optimizers (``ops/adam/fused_adam.py``,
+``ops/adam/cpu_adam.py``, ``ops/lamb``) because eager PyTorch won't fuse the elementwise math.
+Under XLA the math fuses automatically, so an optimizer here is a pair of pure functions over
+pytrees. The protocol matches optax's GradientTransformation shape but threads the learning
+rate as a traced argument so LR schedules never trigger recompilation.
+"""
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+
+
+class Optimizer(NamedTuple):
+    """``init(params) -> state``; ``update(grads, state, params, lr) -> (new_params, state)``."""
+    init: Callable[[Any], Any]
+    update: Callable[..., Any]
+    name: str = "optimizer"
+
+
+def from_optax(tx, name: str = "optax") -> Optimizer:
+    """Wrap an optax GradientTransformation (ignores the ``lr`` argument — bake the schedule
+    into the transform, or use ``optax.inject_hyperparams``)."""
+
+    def init(params):
+        return tx.init(params)
+
+    def update(grads, state, params, lr=None):
+        updates, new_state = tx.update(grads, state, params)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return new_params, new_state
+
+    return Optimizer(init=init, update=update, name=name)

@@ -1,0 +1,58 @@
+"""Attention ops with switchable implementations.
+
+The training-side analogue of the reference's fused attention kernels
+(``csrc/transformer/softmax_kernels.cu`` + strided-batch-gemm attention in
+``csrc/transformer/ds_transformer_cuda.cpp``): on TPU the baseline is plain XLA einsum+softmax
+(which the compiler fuses and tiles onto the MXU); the ``flash``/``ring`` implementations are
+Pallas kernels (``ops/attention/``) selected by name so models stay implementation-agnostic.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  causal: bool = True, mask: Optional[jnp.ndarray] = None,
+                  softmax_scale: Optional[float] = None,
+                  dropout_rate: float = 0.0,
+                  dropout_rng=None) -> jnp.ndarray:
+    """Reference multi-head attention.
+
+    Shapes: q/k/v ``(batch, seq, heads, head_dim)`` → out ``(batch, seq, heads, head_dim)``.
+    Softmax runs in fp32 regardless of input dtype (the reference's attn_softmax kernels do the
+    same for fp16 inputs).
+    """
+    *_, t, h, d = q.shape
+    s = k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        logits = jnp.where(causal_mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        # mask: (batch, s) padding mask or (batch, 1, t, s) full mask
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        logits = jnp.where(mask.astype(bool), logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def get_attention_impl(name: str = "xla"):
+    """Resolve an attention implementation by name: ``xla`` | ``flash`` | ``ring``."""
+    if name == "xla":
+        return xla_attention
+    if name == "flash":
+        from ..attention.flash import flash_attention
+        return flash_attention
+    if name == "ring":
+        from ..attention.ring import ring_attention
+        return ring_attention
+    raise ValueError(f"Unknown attention impl {name!r}")

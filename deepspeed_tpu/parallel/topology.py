@@ -37,7 +37,8 @@ class ProcessTopology:
     def get_axis_names(self) -> List[str]:
         return self.axes
 
-    def get_rank_repr(self, rank: int, omit_axes=("data",), inner_sep="_", outer_sep="-") -> str:
+    def get_rank_repr(self, rank: int, omit_axes=("data", "pipe"), inner_sep="_",
+                      outer_sep="-") -> str:
         omit_axes = list(omit_axes)
         axes = [a for a in self.axes if a not in omit_axes]
         names = []

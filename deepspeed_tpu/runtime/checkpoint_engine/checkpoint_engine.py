@@ -1,0 +1,97 @@
+"""Pluggable checkpoint backends.
+
+Behavioural equivalent of reference ``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py``
+(``CheckpointEngine`` ABC) + ``torch_checkpoint_engine.py`` + ``nebula_checkpoint_engine.py``.
+The default backend is Orbax/TensorStore, which natively writes *sharded, re-shardable* arrays —
+this is what makes every checkpoint a "universal checkpoint" (reference
+``checkpoint/universal_checkpoint.py``) by construction: restore may specify any sharding/mesh.
+"""
+
+import json
+import os
+import pickle
+from typing import Any, Optional
+
+from ...utils.logging import logger
+
+
+class CheckpointEngine:
+    """save/load/commit surface, mirroring the reference ABC."""
+
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag: str):
+        logger.info(f"[ckpt] start checkpoint {tag}")
+
+    def save(self, state_dict: Any, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None, template: Any = None,
+             shardings: Any = None) -> Any:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        logger.info(f"[ckpt] checkpoint {tag} ready")
+        return True
+
+    def makedirs(self, path: str, exist_ok: bool = True):
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Array trees via Orbax (sharded + re-shardable); side metadata via JSON/pickle.
+
+    ``save``/``load`` paths ending in ``.pkl``/``.json`` handle host-side state (scheduler,
+    client state); other paths are treated as Orbax pytree directories.
+    """
+
+    def __init__(self, config_params=None, use_async: bool = False):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.use_async = use_async
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, state_dict: Any, path: str):
+        if path.endswith(".json"):
+            with open(path, "w") as f:
+                json.dump(state_dict, f, indent=2, default=str)
+            return
+        if path.endswith(".pkl"):
+            with open(path, "wb") as f:
+                pickle.dump(state_dict, f)
+            return
+        self._ckptr.save(os.path.abspath(path), state_dict, force=True)
+        self._ckptr.wait_until_finished()
+
+    def load(self, path: str, map_location=None, template: Any = None,
+             shardings: Any = None) -> Any:
+        if path.endswith(".json"):
+            with open(path) as f:
+                return json.load(f)
+        if path.endswith(".pkl"):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        import jax
+        if template is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda l, s=None: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                                       sharding=s) if hasattr(l, "shape") else l,
+                template)
+            if shardings is not None:
+                abstract = jax.tree_util.tree_map(
+                    lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s)
+                    if hasattr(l, "shape") else l,
+                    template, shardings)
+            return self._ckptr.restore(os.path.abspath(path), abstract)
+        return self._ckptr.restore(os.path.abspath(path))
+
+    def commit(self, tag: str) -> bool:
+        self._ckptr.wait_until_finished()
+        return super().commit(tag)
+
+
+def make_checkpoint_engine(checkpoint_config=None) -> CheckpointEngine:
+    use_async = bool(getattr(checkpoint_config, "async_save", False))
+    return OrbaxCheckpointEngine(checkpoint_config, use_async=use_async)

@@ -1,0 +1,107 @@
+"""Data loading.
+
+Behavioural equivalent of reference ``deepspeed/runtime/dataloader.py``
+(``DeepSpeedDataLoader:39``, ``RepeatingLoader:16``). Each JAX *process* loads its slice of the
+global batch (rank-sharded sampling, the DistributedSampler role); the engine assembles the
+process-local arrays into globally-sharded ``jax.Array``s via
+``make_array_from_process_local_data``.
+"""
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Reference ``dataloader.py:16`` — wrap an iterator to restart on StopIteration."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+    def __len__(self):
+        return len(self.loader)
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: _default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    arr = np.stack([np.asarray(s) for s in samples])
+    return arr
+
+
+class DeepSpeedDataLoader:
+    """Rank-aware micro-batch loader over an indexable or iterable dataset.
+
+    Yields process-local batches of shape ``(local_micro_batch, ...)`` where
+    ``local_micro_batch = micro_batch_per_device * local_dp_devices``. With torch installed, a
+    ``torch.utils.data.DataLoader`` may be passed straight through to the engine instead.
+    """
+
+    def __init__(self, dataset, batch_size: int, num_replicas: int = 1, rank: int = 0,
+                 collate_fn: Optional[Callable] = None, shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.drop_last = drop_last
+        self._indexable = hasattr(dataset, "__getitem__") and hasattr(dataset, "__len__")
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        if not self._indexable:
+            raise TypeError("length of an iterable dataset is unknown")
+        per_replica = len(self.dataset) // self.num_replicas
+        n = per_replica // self.batch_size
+        if not self.drop_last and per_replica % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self) -> Iterator[Any]:
+        if self._indexable:
+            n = len(self.dataset)
+            order = np.arange(n)
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self.epoch)
+                rng.shuffle(order)
+            # contiguous rank shard, like DistributedSampler without padding
+            per = n // self.num_replicas
+            order = order[self.rank * per:(self.rank + 1) * per]
+            for i in range(0, len(order), self.batch_size):
+                idx = order[i:i + self.batch_size]
+                if self.drop_last and len(idx) < self.batch_size:
+                    break
+                yield self.collate_fn([self.dataset[int(j)] for j in idx])
+        else:
+            buf = []
+            for item_i, sample in enumerate(self.dataset):
+                if item_i % self.num_replicas != self.rank:
+                    continue
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield self.collate_fn(buf)
+                    buf = []
+            if buf and not self.drop_last:
+                yield self.collate_fn(buf)

@@ -1,0 +1,99 @@
+"""Static and dynamic loss scaling — functional, jit-compatible.
+
+Behavioural equivalent of reference ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler:59``, ``DynamicLossScaler:82``): scale the loss before differentiation so fp16
+gradients don't underflow; on overflow skip the step and halve the scale (respecting
+hysteresis); after ``scale_window`` clean steps double it.
+
+Unlike the reference's stateful object mutated between autograd calls, the scaler state here is
+a pytree threaded through the jitted train step, updated with ``lax.cond``-free arithmetic so it
+lives entirely on device.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    cur_scale: jnp.ndarray       # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    iteration: jnp.ndarray       # i32 scalar
+
+
+def make_static_state(scale: float) -> LossScaleState:
+    return LossScaleState(
+        cur_scale=jnp.float32(scale),
+        cur_hysteresis=jnp.int32(1),
+        last_overflow_iter=jnp.int32(-1),
+        iteration=jnp.int32(0),
+    )
+
+
+class DynamicLossScaler:
+    """Pure update rules over :class:`LossScaleState`.
+
+    Reference defaults mirror ``fp16/loss_scaler.py:82`` (init 2**32 there; DeepSpeed's engine
+    uses ``initial_scale_power`` from config, default 2**16).
+    """
+
+    def __init__(self, init_scale: float = 2.0**16, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0, delayed_shift: int = 1,
+                 consecutive_hysteresis: bool = False):
+        self.init_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift  # "hysteresis" in config
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init_state(self) -> LossScaleState:
+        return LossScaleState(
+            cur_scale=jnp.float32(self.init_scale),
+            cur_hysteresis=jnp.int32(self.delayed_shift),
+            last_overflow_iter=jnp.int32(-1),
+            iteration=jnp.int32(0),
+        )
+
+    def update(self, state: LossScaleState, overflow: jnp.ndarray) -> LossScaleState:
+        """One iteration's scale update. ``overflow`` is a traced bool scalar."""
+        it = state.iteration
+        # --- overflow branch ------------------------------------------------
+        hysteresis_exhausted = state.cur_hysteresis <= 1
+        dec_scale = jnp.maximum(state.cur_scale / self.scale_factor, self.min_scale)
+        of_scale = jnp.where(hysteresis_exhausted, dec_scale, state.cur_scale)
+        of_hyst = jnp.where(hysteresis_exhausted, state.cur_hysteresis,
+                            state.cur_hysteresis - 1)
+        # --- clean branch ---------------------------------------------------
+        # growth when scale_window clean iterations have passed since the last overflow
+        # (reference fp16/loss_scaler.py: (cur_iter - last_overflow_iter) % window == 0)
+        window_done = (it - state.last_overflow_iter) % self.scale_window == 0
+        ok_scale = jnp.where(window_done, state.cur_scale * self.scale_factor,
+                             state.cur_scale)
+        ok_hyst = (jnp.int32(self.delayed_shift) if self.consecutive_hysteresis
+                   else state.cur_hysteresis)
+        return LossScaleState(
+            cur_scale=jnp.where(overflow, of_scale, ok_scale),
+            cur_hysteresis=jnp.where(overflow, of_hyst, ok_hyst).astype(jnp.int32),
+            last_overflow_iter=jnp.where(overflow, it, state.last_overflow_iter),
+            iteration=it + 1,
+        )
+
+
+def create_loss_scaler(fp16_config) -> "tuple[DynamicLossScaler, LossScaleState]":
+    """Build scaler + initial state from an ``FP16Config`` (dynamic iff loss_scale == 0)."""
+    if not fp16_config.enabled:
+        scaler = DynamicLossScaler(init_scale=1.0, scale_window=10**9, min_scale=1.0)
+        return scaler, make_static_state(1.0)
+    if fp16_config.dynamic:
+        scaler = DynamicLossScaler(
+            init_scale=2.0**fp16_config.initial_scale_power,
+            scale_window=fp16_config.loss_scale_window,
+            min_scale=fp16_config.min_loss_scale,
+            delayed_shift=fp16_config.hysteresis,
+        )
+        return scaler, scaler.init_state()
+    scaler = DynamicLossScaler(init_scale=fp16_config.loss_scale, scale_window=10**9,
+                               min_scale=fp16_config.loss_scale)
+    return scaler, make_static_state(fp16_config.loss_scale)

@@ -1,0 +1,21 @@
+"""Pipeline module (LayerSpec/PipelineModule) — full implementation with the pipeline engine.
+
+Reference: ``deepspeed/runtime/pipe/module.py`` (``LayerSpec:26``, ``PipelineModule:88``).
+"""
+
+
+class LayerSpec:
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+class PipelineModule:
+    """Placeholder until runtime/pipe/engine.py lands (build-plan phase 5)."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("PipelineModule arrives with the pipeline engine phase")

@@ -1,0 +1,94 @@
+"""Runtime helpers: norms, clipping, memory reporting.
+
+Behavioural equivalents of reference ``deepspeed/runtime/utils.py`` (1019 LoC):
+``clip_grad_norm_``, ``get_global_norm``, ``CheckOverflow``, ``see_memory_usage``,
+``DummyOptim``. The tensor math is pytree-functional and jit-safe; partitioned-flat-buffer
+helpers have no TPU analogue (XLA owns layout) and are intentionally absent.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    """L2 norm over every leaf (fp32 accumulation). Reference ``get_global_norm``."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(tree: Any, max_norm: float,
+                        norm: Optional[jnp.ndarray] = None) -> Any:
+    """Reference ``clip_grad_norm_`` semantics (scale all grads by max_norm/total_norm)."""
+    if norm is None:
+        norm = global_norm(tree)
+    # match torch semantics: clip only when norm exceeds max_norm
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda l: (l * factor).astype(l.dtype), tree)
+
+
+def has_overflow(tree: Any) -> jnp.ndarray:
+    """Any non-finite leaf? Reference ``CheckOverflow`` (runtime/utils.py)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.array(False)
+    finite = jnp.array(True)
+    for l in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(l.astype(jnp.float32))))
+    return jnp.logical_not(finite)
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    """Cast floating leaves to ``dtype`` (dtype policy for mixed precision)."""
+    def cast(l):
+        if jnp.issubdtype(l.dtype, jnp.floating):
+            return l.astype(dtype)
+        return l
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def tree_zeros_like(tree: Any, dtype=None) -> Any:
+    return jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, dtype or l.dtype), tree)
+
+
+def count_parameters(tree: Any) -> int:
+    return sum(int(l.size) for l in jax.tree_util.tree_leaves(tree))
+
+
+def see_memory_usage(message: str, force: bool = False):
+    """Reference ``see_memory_usage``: device + host memory snapshot."""
+    if not force:
+        return
+    try:
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
+        gb = 1024**3
+        logger.info(
+            f"{message} | device mem: in_use={stats.get('bytes_in_use', 0)/gb:.2f}GB "
+            f"peak={stats.get('peak_bytes_in_use', 0)/gb:.2f}GB "
+            f"limit={stats.get('bytes_limit', 0)/gb:.2f}GB")
+    except Exception:
+        logger.info(f"{message} | device memory stats unavailable")
+    try:
+        import resource
+        rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1024**2)
+        logger.info(f"{message} | host max RSS: {rss_gb:.2f}GB")
+    except Exception:
+        pass
+
+
+class DummyOptim:
+    """Placeholder optimizer when the user manages updates externally.
+
+    Reference ``runtime/utils.py:DummyOptim``.
+    """
+
+    def __init__(self, params=None):
+        self.params = params

@@ -151,9 +151,6 @@ class ThroughputTimer:
         self.epoch_count += 1
         self.micro_step_count = 0
 
-    def _init_timer(self):
-        self.initialized = True
-
     def start(self):
         self.started = True
         if self.global_step_count >= self.start_step:
@@ -174,19 +171,21 @@ class ThroughputTimer:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             self.start_time = 0.0
-            if global_step and report_speed and \
-                    self.global_step_count % self.steps_per_output == 0:
-                msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                       f"global_step={self.global_step_count}, "
-                       f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
-                       f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
-                if self.flops_per_sample:
-                    tflops = (self.flops_per_sample * self.batch_size /
-                              self.step_elapsed_time) / 1e12
-                    msg += f", TFLOPS={tflops:.2f}"
-                if self.monitor_memory:
-                    msg += ", " + SynchronizedWallClockTimer.memory_usage()
-                self.logging(msg)
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                           f"global_step={self.global_step_count}, "
+                           f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                           f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
+                    if self.flops_per_sample:
+                        tflops = (self.flops_per_sample * self.batch_size /
+                                  self.step_elapsed_time) / 1e12
+                        msg += f", TFLOPS={tflops:.2f}"
+                    if self.monitor_memory:
+                        msg += ", " + SynchronizedWallClockTimer.memory_usage()
+                    self.logging(msg)
+                # reset per-step accumulator every global step (reference timer.py:223),
+                # not only when reporting — otherwise CurrSamplesPerSec is ~window x too low
                 self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self) -> float:

@@ -1,0 +1,55 @@
+"""Dynamic loss scaler unit tests — analogue of reference
+``tests/unit/runtime/half_precision/test_dynamic_loss_scale.py``."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler
+
+
+def test_overflow_halves_scale():
+    s = DynamicLossScaler(init_scale=2.0**8, scale_window=1000, min_scale=1.0)
+    st = s.init_state()
+    st = s.update(st, jnp.array(True))
+    assert float(st.cur_scale) == 2.0**7
+
+
+def test_scale_window_doubles():
+    s = DynamicLossScaler(init_scale=4.0, scale_window=3)
+    st = s.init_state()
+    for _ in range(3):
+        st = s.update(st, jnp.array(False))
+    assert float(st.cur_scale) == 8.0
+
+
+def test_min_scale_floor():
+    s = DynamicLossScaler(init_scale=2.0, scale_window=1000, min_scale=1.0)
+    st = s.init_state()
+    for _ in range(5):
+        st = s.update(st, jnp.array(True))
+    assert float(st.cur_scale) == 1.0
+
+
+def test_hysteresis_delays_decrease():
+    s = DynamicLossScaler(init_scale=2.0**8, delayed_shift=3)
+    st = s.init_state()
+    st = s.update(st, jnp.array(True))   # hysteresis 3→2, scale keeps
+    assert float(st.cur_scale) == 2.0**8
+    st = s.update(st, jnp.array(True))   # 2→1
+    assert float(st.cur_scale) == 2.0**8
+    st = s.update(st, jnp.array(True))   # exhausted → halve
+    assert float(st.cur_scale) == 2.0**7
+
+
+def test_window_resets_after_overflow():
+    s = DynamicLossScaler(init_scale=4.0, scale_window=3)
+    st = s.init_state()
+    st = s.update(st, jnp.array(False))
+    st = s.update(st, jnp.array(True))   # overflow at iter 1 → scale 2
+    assert float(st.cur_scale) == 2.0
+    st = s.update(st, jnp.array(False))
+    st = s.update(st, jnp.array(False))
+    # only 2 clean iters since overflow → no growth yet
+    assert float(st.cur_scale) == 2.0
+    st = s.update(st, jnp.array(False))
+    assert float(st.cur_scale) == 4.0

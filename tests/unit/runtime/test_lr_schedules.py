@@ -1,0 +1,82 @@
+"""LR schedule tests — analogue of reference ``tests/unit/runtime/test_lr_schedulers.py``."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest, OneCycle, WarmupDecayLR, WarmupLR, get_lr_scheduler)
+
+
+def test_warmup_lr_linear():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                 warmup_type="linear")
+    s.step(0)
+    assert s.get_last_lr()[0] == 0.0
+    s.step(5)
+    assert abs(s.get_last_lr()[0] - 0.05) < 1e-9
+    s.step(20)
+    assert s.get_last_lr()[0] == 0.1
+
+
+def test_warmup_lr_log():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100,
+                 warmup_type="log")
+    s.step(99)
+    assert abs(s.get_last_lr()[0] - 0.1) < 5e-3
+    s.step(200)
+    assert s.get_last_lr()[0] == 0.1
+
+
+def test_warmup_decay_lr():
+    s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10,
+                      warmup_type="linear")
+    s.step(10)
+    assert abs(s.get_last_lr()[0] - 0.1) < 1e-9
+    s.step(55)
+    assert abs(s.get_last_lr()[0] - 0.05) < 1e-9
+    s.step(100)
+    assert s.get_last_lr()[0] == 0.0
+    s.step(150)
+    assert s.get_last_lr()[0] == 0.0
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    s.step(0)
+    assert abs(s.get_last_lr()[0] - 0.01) < 1e-9
+    s.step(10)
+    assert abs(s.get_last_lr()[0] - 0.1) < 1e-9
+    s.step(20)
+    assert abs(s.get_last_lr()[0] - 0.01) < 1e-9
+
+
+def test_one_cycle_decay():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10,
+                 decay_lr_rate=0.1, decay_step_size=5)
+    s.step(30)  # 10 steps past cycle end (20) → 2 decay intervals
+    assert s.get_last_lr()[0] == pytest.approx(0.01 / 1.2)
+
+
+def test_lr_range_test_staircase():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=5,
+                    lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    s.step(4)
+    assert s.get_last_lr()[0] == pytest.approx(0.01)
+    s.step(5)
+    assert s.get_last_lr()[0] == pytest.approx(0.02)
+
+
+def test_registry():
+    s = get_lr_scheduler("WarmupLR", {"warmup_max_lr": 0.5})
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        get_lr_scheduler("Nope", {})
+
+
+def test_state_dict_roundtrip():
+    s = WarmupLR(warmup_max_lr=0.1)
+    s.step(42)
+    s2 = WarmupLR(warmup_max_lr=0.1)
+    s2.load_state_dict(s.state_dict())
+    assert s2.last_batch_iteration == 42

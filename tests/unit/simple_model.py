@@ -1,0 +1,67 @@
+"""Tiny model fixtures — analogue of reference ``tests/unit/simple_model.py``
+(``SimpleModel:15``, ``random_dataloader:238``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.base import Model
+
+
+def simple_model(hidden_dim: int = 16, n_layers: int = 2, seed_shift: int = 0) -> Model:
+    """MLP regression model: batch = {"x": (B, H), "y": (B, H)}, MSE loss."""
+
+    def init_fn(rng):
+        params = {}
+        for i in range(n_layers):
+            rng, k1, k2 = jax.random.split(rng, 3)
+            params[f"w{i}"] = jax.random.normal(k1, (hidden_dim, hidden_dim),
+                                                jnp.float32) * 0.1
+            params[f"b{i}"] = jnp.zeros((hidden_dim,), jnp.float32)
+        return params
+
+    def forward(params, x):
+        h = x
+        for i in range(n_layers):
+            h = h @ params[f"w{i}"] + params[f"b{i}"]
+            if i < n_layers - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def loss_fn(params, batch, rng):
+        pred = forward(params, batch["x"])
+        return jnp.mean((pred - batch["y"].astype(pred.dtype)) ** 2)
+
+    def apply_fn(params, batch, rng=None):
+        x = batch["x"] if isinstance(batch, dict) else batch
+        return forward(params, x)
+
+    return Model(loss_fn=loss_fn, init_fn=init_fn, apply_fn=apply_fn,
+                 name=f"SimpleModel(h{hidden_dim})")
+
+
+def random_batches(n_batches: int, batch_size: int, hidden_dim: int = 16, seed: int = 0,
+                   dtype=np.float32):
+    """Analogue of reference ``random_dataloader``; targets are a fixed linear map of the
+    inputs so the loss is actually learnable."""
+    rng = np.random.default_rng(seed)
+    w_true = np.random.default_rng(1234).standard_normal(
+        (hidden_dim, hidden_dim)).astype(np.float32) * 0.3
+    out = []
+    for _ in range(n_batches):
+        x = rng.standard_normal((batch_size, hidden_dim)).astype(dtype)
+        out.append({"x": x, "y": (x @ w_true).astype(dtype)})
+    return out
+
+
+def base_config(batch_size: int = 16, gas: int = 1, stage: int = 0, lr: float = 1e-2,
+                **extra):
+    cfg = {
+        "train_batch_size": batch_size,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": lr}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 10**9,
+    }
+    cfg.update(extra)
+    return cfg

@@ -78,4 +78,7 @@ def test_grid_first_last_stage():
 def test_rank_repr():
     topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
     s = topo.get_rank_repr(0)
-    assert "pipe_00" in s and "model_00" in s and "data" not in s
+    # default omits data and pipe (reference topology.py:65); pipe stage is encoded in
+    # layer-file names instead
+    assert s == "model_00"
+    assert "pipe_01" in topo.get_rank_repr(topo.world_size() - 1, omit_axes=("data",))
