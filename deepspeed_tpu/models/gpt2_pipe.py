@@ -1,0 +1,79 @@
+"""Pipelined GPT-2: the LayerSpec decomposition of ``models/gpt2.py``.
+
+Reference analogue: Megatron-style ``GPT2ModelPipe`` built from ``LayerSpec``s (the usage
+pattern ``deepspeed/runtime/pipe/module.py`` is designed for; see reference
+``tests/unit/simple_model.py:LinearStackPipe``). The embedding is tied with the LM head via
+``TiedLayerSpec`` (reference ``module.py:74``).
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..runtime.pipe.module import FlaxPipeLayer, LayerSpec, PipelineModule, TiedLayerSpec
+from .gpt2 import Block, GPT2Config, cross_entropy_loss
+
+
+class GPT2EmbedPipe(nn.Module):
+    """wte + wpe + dropout; owns the tied embedding table."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.config
+        wte = self.param("wte", nn.initializers.normal(cfg.init_std),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(cfg.init_std),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        t = input_ids.shape[-1]
+        x = wte[input_ids].astype(cfg.dtype) + wpe[:t][None].astype(cfg.dtype)
+        return nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
+
+
+class GPT2FinalNorm(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+
+
+def _embed_layer(cfg):
+    return FlaxPipeLayer(GPT2EmbedPipe(cfg), deterministic_kwarg=True)
+
+
+def _block_layer(cfg):
+    return FlaxPipeLayer(Block(cfg), deterministic_kwarg=True)
+
+
+def _norm_layer(cfg):
+    return FlaxPipeLayer(GPT2FinalNorm(cfg), deterministic_kwarg=True)
+
+
+def _tied_head_forward(base_layer, params, x):
+    """LM head reusing the tied wte (reference TiedLayerSpec forward_fn pattern)."""
+    return x.astype(jnp.float32) @ params["wte"].T
+
+
+def gpt2_pipeline_module(config: GPT2Config, num_stages: int,
+                         sample_seq_len: Optional[int] = None,
+                         sample_batch_size: int = 1,
+                         activation_checkpoint_interval: int = 1,
+                         partition_method: str = "uniform") -> PipelineModule:
+    t = sample_seq_len or config.n_positions
+    sample = jnp.zeros((sample_batch_size, t), dtype=jnp.int32)
+    layers = [
+        TiedLayerSpec("embed", _embed_layer, config),
+        *[LayerSpec(_block_layer, config) for _ in range(config.n_layer)],
+        LayerSpec(_norm_layer, config),
+        TiedLayerSpec("embed", _embed_layer, config, forward_fn=_tied_head_forward),
+    ]
+    return PipelineModule(
+        layers=layers,
+        num_stages=num_stages,
+        loss_fn=cross_entropy_loss,
+        sample_input=sample,
+        partition_method=partition_method,
+        activation_checkpoint_interval=activation_checkpoint_interval,
+    )

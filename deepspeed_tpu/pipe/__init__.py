@@ -1,0 +1,6 @@
+"""Public pipeline-parallelism namespace (reference ``deepspeed/pipe/__init__.py``)."""
+
+from ..runtime.pipe import (FlaxPipeLayer, LambdaLayer, LayerSpec, PipeLayer,
+                            PipelineModule, TiedLayerSpec)
+from ..parallel.topology import (PipeDataParallelTopology, PipeModelDataParallelTopology,
+                                 ProcessTopology)

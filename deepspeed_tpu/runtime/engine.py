@@ -203,7 +203,9 @@ class DeepSpeedEngine:
                          out_shardings=self._param_shardings)(rng)
 
         abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
-        self._opt_spec_tree = optimizer_state_specs(abstract_opt, mesh, self.zero_stage)
+        self._opt_spec_tree = optimizer_state_specs(
+            abstract_opt, mesh, self.zero_stage,
+            abstract_params=abstract_params, param_spec_tree=self._param_spec_tree)
         self._opt_shardings = to_shardings(self._opt_spec_tree, mesh)
         opt_state = jax.jit(self.optimizer.init,
                             out_shardings=self._opt_shardings)(params)
@@ -299,21 +301,9 @@ class DeepSpeedEngine:
             metrics["loss"] = jnp.mean(losses)
             return new_state, metrics
 
-        batch_sharding = NamedSharding(self.mesh_spec.mesh,
-                                       self.mesh_spec.batch_spec(extra_dims=0))
-
-        def batch_shardings_for(batch):
-            # (gas, B, ...) → shard dim 1 over batch axes
-            def one(leaf):
-                spec = [None, tuple(ax for ax in ("data", "fsdp", "expert")
-                                    if self.mesh_spec.size(ax) > 1) or None]
-                spec += [None] * (leaf.ndim - 2)
-                return NamedSharding(self.mesh_spec.mesh, P(*spec))
-            return jax.tree_util.tree_map(one, batch)
-
         jitted = jax.jit(train_step, donate_argnums=(0,),
                          out_shardings=(self._state_shardings, None))
-        self._fns["train_step"] = (jitted, batch_shardings_for)
+        self._fns["train_step"] = jitted
 
     def _build_micro_fns(self):
         """Eager-compatible forward/backward/step path (reference API)."""
@@ -390,7 +380,7 @@ class DeepSpeedEngine:
                 raise ValueError("train_batch needs batch=, data_iter=, or training_data")
         if "train_step" not in self._fns:
             self._build_train_step()
-        jitted, batch_shardings_for = self._fns["train_step"]
+        jitted = self._fns["train_step"]
         local = self._reshape_for_gas(batch)
         gbatch = self._globalize(local, leading_gas=True)
 
@@ -484,7 +474,7 @@ class DeepSpeedEngine:
         if "eval_step" not in self._fns:
             self._build_micro_fns()
         gb = self._globalize(batch)
-        rng = jax.random.fold_in(self._base_rng, -1)
+        rng = jax.random.fold_in(self._base_rng, 0x7FFFFFFF)
         return self._fns["eval_step"](self.state.params, gb, rng)
 
     def _write_monitor_events(self, metrics):

@@ -40,7 +40,6 @@ class _Schedule:
     @property
     def lr(self) -> float:
         if self.last_batch_iteration < 0:
-            probe = self.__class__.__dict__.get("get_lr")
             self.last_batch_iteration = 0
             out = self.get_lr()[0]
             self.last_batch_iteration = -1

@@ -1,0 +1,6 @@
+from .module import (FlaxPipeLayer, LambdaLayer, LayerSpec, PipeLayer, PipelineModule,
+                     TiedLayerSpec, partition_balanced)
+from .schedule import (BackwardPass, DataParallelSchedule, ForwardPass, InferenceSchedule,
+                       LoadMicroBatch, OptimizerStep, PipeInstruction, PipeSchedule,
+                       RecvActivation, RecvGrad, ReduceGrads, ReduceTiedGrads,
+                       SendActivation, SendGrad, TrainSchedule)

@@ -1,21 +1,495 @@
-"""Pipeline module (LayerSpec/PipelineModule) — full implementation with the pipeline engine.
+"""Pipeline module: LayerSpec / TiedLayerSpec / PipelineModule.
 
-Reference: ``deepspeed/runtime/pipe/module.py`` (``LayerSpec:26``, ``PipelineModule:88``).
+Reference: ``deepspeed/runtime/pipe/module.py`` (``LayerSpec:26``, ``TiedLayerSpec:74``,
+``PipelineModule:88``, partitioning ``_partition_layers:367``, tied weights ``:423-445``).
+
+TPU-native redesign: instead of materialising per-stage ``nn.Sequential`` fragments in separate
+processes, the module classifies its layer list into
+
+- ``pre``  — leading heterogeneous layers (embeddings…), computed on every device (replicated
+  over the ``pipe`` axis, sharded over data/tensor axes as usual);
+- ``body`` — the longest homogeneous run of layers (the transformer blocks): their params are
+  *stacked* along a leading layer dimension and sharded over the ``pipe`` mesh axis, so each
+  stage physically holds only its own blocks;
+- ``post`` — trailing layers (final norm, LM head), replicated like ``pre``.
+
+The pipelined forward is an SPMD collective-permute loop (GPipe fill-drain over
+``micro_batches + stages - 1`` iterations) under ``jax.shard_map`` manual only over ``pipe``;
+``jax.lax.ppermute`` moves activations stage→stage+1 and autodiff through the loop transposes it
+into the backward drain (reverse permutes), giving the 1F1B-equivalent bubble fraction
+``(S-1)/(M+S-1)``. Activation memory is bounded by per-microbatch remat (``jax.checkpoint``) —
+the role 1F1B plays in the reference.
+
+Tied layers (``TiedLayerSpec``) share one parameter entry under ``params['tied'][key]``; since
+pre/post are replicated over ``pipe`` there is no tied-weight gradient all-reduce to schedule —
+XLA's psum over the batch axes already covers it.
 """
+
+import re
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...models.base import Model
+from ...parallel.mesh import AXIS_PIPE, MeshSpec
+from ...utils.logging import logger
+
+
+# --------------------------------------------------------------------------- layer contract
+class PipeLayer:
+    """A pipeline layer: ``init(rng, x) -> params`` and ``apply(params, x, rng) -> y``."""
+
+    def init(self, rng, x):
+        return {}
+
+    def apply(self, params, x, rng=None):
+        raise NotImplementedError
+
+
+class LambdaLayer(PipeLayer):
+    """Parameterless function layer (reference allows bare callables in the layer list)."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def apply(self, params, x, rng=None):
+        return self.fn(x)
+
+
+class FlaxPipeLayer(PipeLayer):
+    """Adapt a ``flax.linen`` module to the PipeLayer contract.
+
+    ``deterministic_kwarg``: pass ``deterministic=(rng is None)`` through to the module (the
+    convention of our transformer blocks).
+    """
+
+    def __init__(self, module, deterministic_kwarg: bool = False):
+        self.module = module
+        self.deterministic_kwarg = deterministic_kwarg
+
+    def _kwargs(self, rng):
+        return {"deterministic": rng is None} if self.deterministic_kwarg else {}
+
+    def init(self, rng, x):
+        rngs = {"params": rng, "dropout": rng}
+        return self.module.init(rngs, x, **self._kwargs(rng))["params"]
+
+    def apply(self, params, x, rng=None):
+        rngs = {"dropout": rng} if rng is not None else {}
+        return self.module.apply({"params": params}, x, rngs=rngs, **self._kwargs(rng))
 
 
 class LayerSpec:
+    """Deferred layer construction (reference ``module.py:26``) — lets huge models describe
+    themselves without materialising parameters until partitioning is known."""
+
     def __init__(self, typename, *module_args, **module_kwargs):
         self.typename = typename
         self.module_args = module_args
         self.module_kwargs = module_kwargs
 
-    def build(self):
-        return self.typename(*self.module_args, **self.module_kwargs)
+    def build(self) -> PipeLayer:
+        obj = self.typename(*self.module_args, **self.module_kwargs)
+        return _as_pipe_layer(obj)
 
 
+class TiedLayerSpec(LayerSpec):
+    """Layer sharing parameters with every other tied layer of the same ``key``
+    (reference ``module.py:74``)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+    def build(self) -> PipeLayer:
+        layer = super().build()
+        if self.forward_fn is not None:
+            fwd = self.forward_fn
+            base = layer
+
+            class _TiedForward(PipeLayer):
+                def init(self, rng, x):
+                    return base.init(rng, x)
+
+                def apply(self, params, x, rng=None):
+                    return fwd(base, params, x)
+
+            return _TiedForward()
+        return layer
+
+
+def _as_pipe_layer(obj) -> PipeLayer:
+    if isinstance(obj, PipeLayer):
+        return obj
+    if callable(obj) and not hasattr(obj, "init"):
+        return LambdaLayer(obj)
+    if hasattr(obj, "apply") and hasattr(obj, "init"):  # flax module duck-type
+        return FlaxPipeLayer(obj)
+    raise TypeError(f"Cannot adapt {obj!r} to a pipeline layer")
+
+
+# --------------------------------------------------------------------------- partitioning
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Split ``weights`` into ``num_parts`` contiguous parts minimising the heaviest part.
+
+    Returns part boundaries of length ``num_parts + 1`` (reference
+    ``deepspeed/runtime/utils.py:partition_balanced`` used by ``module.py:_partition_layers``).
+    Classic binary search over the bottleneck value.
+    """
+    n = len(weights)
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    def parts_needed(limit: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end such that sum(start:end) <= limit
+            end = int(np.searchsorted(prefix, prefix[start] + limit, side="right")) - 1
+            if end <= start and start < n:
+                end = start + 1  # always make progress (single item exceeds limit)
+            end = min(end, n)
+            bounds.append(end)
+            start = end
+        return bounds if bounds[-1] >= n else None
+
+    lo, hi = float(max(weights) if len(weights) else 0.0), float(prefix[-1])
+    for _ in range(64):
+        mid = (lo + hi) / 2
+        if parts_needed(mid) is not None:
+            hi = mid
+        else:
+            lo = mid
+    bounds = parts_needed(hi)
+    bounds[-1] = n
+    return bounds
+
+
+# --------------------------------------------------------------------------- module
 class PipelineModule:
-    """Placeholder until runtime/pipe/engine.py lands (build-plan phase 5)."""
+    """See module docstring. Public surface mirrors reference ``PipelineModule:88``."""
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError("PipelineModule arrives with the pipeline engine phase")
+    def __init__(self,
+                 layers: Sequence,
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 sample_input=None,
+                 partition_method: str = "uniform",
+                 activation_checkpoint_interval: int = 0,
+                 seed: int = 1234):
+        if num_stages is None and topology is None:
+            raise RuntimeError("must provide num_stages or topology")
+        if topology is not None and num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = int(num_stages)
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed = seed
+        assert sample_input is not None, \
+            "PipelineModule needs sample_input (abstract is fine) to trace layer shapes"
+        self.sample_input = sample_input
+
+        self._specs = list(layers)
+        self._layers: List[PipeLayer] = []
+        self._tied_keys: List[Optional[str]] = []
+        for spec in self._specs:
+            if isinstance(spec, LayerSpec):
+                self._layers.append(spec.build())
+                self._tied_keys.append(getattr(spec, "key", None))
+            else:
+                self._layers.append(_as_pipe_layer(spec))
+                self._tied_keys.append(None)
+
+        self._trace_structure()
+
+    # ------------------------------------------------------------------ tracing
+    def _trace_structure(self):
+        """eval_shape every layer on the propagated sample activation; find the homogeneous
+        body run; compute stage boundaries."""
+        rng = jax.random.PRNGKey(self.seed)
+        x = self.sample_input
+        shapes = []   # (treedef_repr, leaf shapes) per layer
+        self._abstract_params: List[Any] = []
+        tied_abstract: Dict[str, Any] = {}
+        for i, layer in enumerate(self._layers):
+            key = self._tied_keys[i]
+            if key is not None and key in tied_abstract:
+                p = tied_abstract[key]
+            else:
+                p = jax.eval_shape(partial(layer.init), rng, x)
+                if key is not None:
+                    tied_abstract[key] = p
+            self._abstract_params.append(p)
+            leaves = jax.tree_util.tree_leaves(p)
+            sig = (str(jax.tree_util.tree_structure(p)),
+                   tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+            shapes.append(sig)
+            x = jax.eval_shape(partial(layer.apply), p, x, None)
+        self._output_shape = x
+
+        # longest homogeneous run of layers with parameters
+        best = (0, 0)  # (start, length)
+        i = 0
+        n = len(self._layers)
+        while i < n:
+            # tied layers can never join the body: their params live in params['tied'] and
+            # stacking a copy into params['body'] would silently untie the weights
+            if (not jax.tree_util.tree_leaves(self._abstract_params[i])
+                    or self._tied_keys[i] is not None):
+                i += 1
+                continue
+            j = i + 1
+            while j < n and shapes[j] == shapes[i] and self._tied_keys[j] is None:
+                j += 1
+            if j - i > best[1]:
+                best = (i, j - i)
+            i = j
+        start, length = best
+        S = self.num_stages
+        if length < S:
+            raise ValueError(
+                f"Pipeline needs a homogeneous block run >= num_stages: found {length} "
+                f"homogeneous layers for {S} stages")
+        # trim the run so the body length divides num_stages; spill extras to pre/post
+        spill = length % S
+        start += spill  # keep early layers (closer to embeddings) in pre
+        length -= spill
+        self.body_start = start
+        self.body_end = start + length
+        self.layers_per_stage = length // S
+        if spill:
+            logger.info(f"PipelineModule: spilled {spill} block(s) to the pre segment so "
+                        f"{length} body layers divide {S} stages")
+
+        self.parts = self._compute_parts()
+
+    def _compute_parts(self) -> List[int]:
+        """Stage boundaries over the full layer list (reference ``_partition_layers:367``) —
+        informational/ckpt-naming; the SPMD executor uses the body stacking above."""
+        method = self.partition_method.lower()
+        n = len(self._layers)
+        if method == "uniform":
+            weights = [1.0] * n
+        elif method == "parameters":
+            weights = [float(sum(int(np.prod(l.shape))
+                                 for l in jax.tree_util.tree_leaves(p)))
+                       for p in self._abstract_params]
+        elif method.startswith("type:"):
+            pat = re.compile(method[len("type:"):], re.IGNORECASE)
+            weights = [1.0 if pat.search(type(layer).__name__) else 0.0
+                       for layer in self._layers]
+        else:
+            raise NotImplementedError(f"partition_method {self.partition_method!r}")
+        return partition_balanced(weights, self.num_stages)
+
+    # ------------------------------------------------------------------ params
+    def init_fn(self, rng):
+        """Build the structured param tree: pre/body(stacked)/post/tied."""
+        params = {"pre": {}, "body": None, "post": {}, "tied": {}}
+        x_abs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), self.sample_input)
+        body_stack: List[Any] = []
+        for i, layer in enumerate(self._layers):
+            lrng = jax.random.fold_in(rng, i)
+            key = self._tied_keys[i]
+            x_dummy = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, l.dtype), x_abs)
+            if key is not None and key in params["tied"]:
+                p = params["tied"][key]
+            else:
+                p = layer.init(lrng, x_dummy)
+                if key is not None:
+                    params["tied"][key] = p
+            if self.body_start <= i < self.body_end:
+                body_stack.append(p)
+            elif key is None and jax.tree_util.tree_leaves(p):
+                seg = "pre" if i < self.body_start else "post"
+                params[seg][str(i)] = p
+            x_abs = jax.eval_shape(partial(layer.apply), _abstract(p), x_abs, None)
+        params["body"] = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *body_stack)
+        return params
+
+    def param_specs(self, abstract_params=None) -> Any:
+        """PartitionSpec tree: body stacked dim shards over ``pipe``; rest replicated (TP specs
+        can be layered on by the caller)."""
+        if abstract_params is None:
+            abstract_params = jax.eval_shape(self.init_fn, jax.random.PRNGKey(0))
+
+        def seg_spec(seg_name):
+            def one(leaf):
+                if seg_name == "body":
+                    return P(AXIS_PIPE, *([None] * (leaf.ndim - 1)))
+                return P(*([None] * leaf.ndim))
+            return one
+
+        return {seg: jax.tree_util.tree_map(seg_spec(seg), abstract_params[seg])
+                for seg in ("pre", "body", "post", "tied")}
+
+    # ------------------------------------------------------------------ forward paths
+    def _segment_apply(self, params, x, rng, lo, hi):
+        """Apply layers [lo, hi) sequentially (non-body segments + reference executor)."""
+        for i in range(lo, hi):
+            if self.body_start <= i < self.body_end:
+                continue
+            layer = self._layers[i]
+            key = self._tied_keys[i]
+            p = (params["tied"][key] if key is not None
+                 else params.get("pre", {}).get(str(i),
+                      params.get("post", {}).get(str(i), {})))
+            lrng = None if rng is None else jax.random.fold_in(rng, i)
+            x = layer.apply(p, x, lrng)
+        return x
+
+    def reference_apply(self, params, x, rng=None):
+        """Sequential (non-pipelined) forward — ground truth for tests and single-stage."""
+        body_layer = self._layers[self.body_start]
+        x = self._segment_apply(params, x, rng, 0, self.body_start)
+
+        def body_one(carry, xs):
+            p, r = xs
+            return body_layer.apply(p, carry, None if rng is None else r), None
+
+        n_body = self.body_end - self.body_start
+        rngs = (jax.random.split(jax.random.fold_in(rng, 10**6), n_body)
+                if rng is not None else jnp.zeros((n_body, 2), dtype=jnp.uint32))
+        x, _ = jax.lax.scan(body_one, x, (params["body"], rngs))
+        return self._segment_apply(params, x, rng, self.body_end, len(self._layers))
+
+    def pipelined_apply(self, params, xs, mesh_spec: MeshSpec, rng=None,
+                        remat: bool = True):
+        """GPipe fill-drain loop over the ``pipe`` axis.
+
+        ``xs``: microbatched activations entering the body, shape ``(M, mb, ...)``.
+        Returns body outputs ``(M, mb, ...)``.
+        """
+        S = self.num_stages
+        L_per = self.layers_per_stage
+        body_layer = self._layers[self.body_start]
+        M = xs.shape[0]
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+            use_rng = False
+        else:
+            use_rng = True
+
+        def stage_fn(stage_params, x, srng):
+            def one(carry, xs_):
+                p, r = xs_
+                return body_layer.apply(p, carry, r if use_rng else None), None
+
+            rngs = jax.random.split(srng, L_per)
+            x, _ = jax.lax.scan(one, x, (stage_params, rngs))
+            return x
+
+        if remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        n_iters = M + S - 1
+
+        def run(body_params, xs_local, rng_in):
+            stage = jax.lax.axis_index(AXIS_PIPE)
+            recv0 = jnp.zeros_like(xs_local[0])
+            outs0 = jnp.zeros_like(xs_local)
+
+            def step(carry, t):
+                recv, outs = carry
+                x_in = jnp.where(stage == 0,
+                                 jax.lax.dynamic_index_in_dim(
+                                     xs_local, jnp.clip(t, 0, M - 1), 0, keepdims=False),
+                                 recv)
+                srng = jax.random.fold_in(jax.random.fold_in(rng_in, t), stage)
+                y = stage_fn(body_params, x_in, srng)
+                m = t - stage
+                valid = jnp.logical_and(m >= 0, m < M)
+                m_c = jnp.clip(m, 0, M - 1)
+                outs = jnp.where(
+                    valid,
+                    jax.lax.dynamic_update_index_in_dim(outs, y, m_c, 0),
+                    outs)
+                recv_next = jax.lax.ppermute(
+                    y, AXIS_PIPE, [(i, i + 1) for i in range(S - 1)])
+                return (recv_next, outs), None
+
+            (_, outs), _ = jax.lax.scan(step, (recv0, outs0), jnp.arange(n_iters))
+            return outs[None]  # local (1, M, ...) → stacked (S, M, ...) outside
+
+        if S == 1:
+            return jax.vmap(lambda x, r: stage_fn(params["body"], x, r))(
+                xs, jax.random.split(rng, M))
+
+        mapped = jax.shard_map(
+            run,
+            mesh=mesh_spec.mesh,
+            axis_names={AXIS_PIPE},
+            in_specs=(P(AXIS_PIPE), P(), P()),
+            out_specs=P(AXIS_PIPE),
+            check_vma=False,
+        )
+        stacked = mapped(params["body"], xs, rng)  # (S, M, mb, ...)
+        return stacked[S - 1]
+
+    # ------------------------------------------------------------------ model adapter
+    def to_model(self, mesh_spec: Optional[MeshSpec] = None, name: str = "pipeline",
+                 remat: Optional[bool] = None) -> Model:
+        """Bundle into the engine's :class:`Model` contract. ``loss_fn`` consumes microbatched
+        batches ``(inputs, labels)`` with leading dim M and returns mean loss."""
+        if remat is None:
+            remat = self.activation_checkpoint_interval > 0
+
+        def split_batch(batch):
+            if isinstance(batch, (tuple, list)) and len(batch) == 2:
+                return batch[0], batch[1]
+            if isinstance(batch, dict):
+                return batch["inputs"], batch.get("labels")
+            return batch, None
+
+        def loss_fn(params, batch, rng):
+            mesh = mesh_spec or _require_global_mesh()
+            inputs, labels = split_batch(batch)
+            M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+            pre_rngs = jax.random.split(jax.random.fold_in(rng, 1), M)
+            xs = jax.vmap(
+                lambda inp, r: self._segment_apply(params, inp, r, 0, self.body_start)
+            )(inputs, pre_rngs)
+            ys = self.pipelined_apply(params, xs, mesh,
+                                      rng=jax.random.fold_in(rng, 2), remat=remat)
+            post_rngs = jax.random.split(jax.random.fold_in(rng, 3), M)
+
+            def tail(y, lab, r):
+                out = self._segment_apply(params, y, r, self.body_end, len(self._layers))
+                if self.loss_fn is not None:
+                    return self.loss_fn(out, lab)
+                return out if out.ndim == 0 else jnp.mean(out)
+
+            losses = jax.vmap(tail)(ys, labels, post_rngs)
+            return jnp.mean(losses)
+
+        def apply_fn(params, batch, rng=None):
+            inputs, _ = split_batch(batch)
+            return self.reference_apply(params, inputs, rng)
+
+        return Model(loss_fn=loss_fn, init_fn=self.init_fn, apply_fn=apply_fn,
+                     param_specs=self.param_specs(), name=name)
+
+    def __len__(self):
+        return len(self._layers)
+
+
+def _abstract(p):
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), p)
+
+
+def _require_global_mesh() -> MeshSpec:
+    from ...parallel.mesh import get_global_mesh
+    mesh = get_global_mesh()
+    assert mesh is not None, "pipeline loss_fn needs a global mesh (set by the engine)"
+    return mesh

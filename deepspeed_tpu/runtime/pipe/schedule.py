@@ -1,0 +1,244 @@
+"""Pipeline instruction schedules — pure logic, no devices.
+
+Behavioural equivalent of reference ``deepspeed/runtime/pipe/schedule.py`` (``TrainSchedule:184``,
+``InferenceSchedule:131``, ``DataParallelSchedule:299``, instruction classes ``PipeInstruction:324``).
+
+On TPU the *executed* pipeline is an SPMD collective-permute loop compiled by XLA
+(``runtime/pipe/engine.py``) — every stage runs the same program and the "instructions" are
+iterations of a ``lax.scan``. These instruction streams remain first-class because they (a) define
+the semantics the SPMD loop must match (each microbatch forwarded and backwarded exactly once per
+stage, in dataflow order), (b) drive the host-side eager executor used for debugging, and (c) are
+pure-python testable without any mesh, exactly like the reference's schedule tests
+(``tests/unit/runtime/pipe/test_pipe_schedule.py``).
+
+The generators here are written from the 1F1B algorithm (one-forward-one-backward: each stage
+runs ``stages - stage_id - 1`` warmup forwards, then alternates fwd/bwd, then drains), not
+transcribed from the reference.
+"""
+
+from typing import Iterable, List
+
+
+# --------------------------------------------------------------------------- instructions
+class PipeInstruction:
+    """A single step in a pipeline schedule (reference ``schedule.py:324``)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        if self.kwargs:
+            args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+            return f"{self.name}({args})"
+        return self.name
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.kwargs == other.kwargs
+
+    def __hash__(self):
+        return hash((self.name, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Take the optimizer step (all stages, end of batch)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction (psum over the data axis in SPMD)."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce gradients of tied weights across the stages that own them."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on an activation buffer slot ``buffer_id``."""
+
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """First/last stage: load microbatch into the buffer."""
+
+
+class ForwardPass(BufferOpInstruction):
+    """Run the stage's layers forward on the buffer."""
+
+
+class BackwardPass(BufferOpInstruction):
+    """Backprop the stage's layers for the buffer's microbatch."""
+
+
+class SendActivation(BufferOpInstruction):
+    """Send the buffer's activation to the next stage."""
+
+
+class RecvActivation(BufferOpInstruction):
+    """Receive an activation from the previous stage into the buffer."""
+
+
+class SendGrad(BufferOpInstruction):
+    """Send the activation-gradient to the previous stage."""
+
+
+class RecvGrad(BufferOpInstruction):
+    """Receive the activation-gradient from the next stage."""
+
+
+# --------------------------------------------------------------------------- schedules
+class PipeSchedule:
+    """Base: yields lists of :class:`PipeInstruction` per step for one stage.
+
+    Mirrors the reference contract (``schedule.py:PipeSchedule``): ``steps()`` generates the
+    per-step instruction lists; iteration yields them in order.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterable[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    @property
+    def num_micro_batches(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self) -> int:
+        """Activation buffer slots needed (1F1B in-flight bound)."""
+        return self.stages
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipeline: fill-and-drain (reference ``schedule.py:131``)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for step_id in range(total):
+            cmds: List[PipeInstruction] = []
+            mb = step_id - self.stage_id
+            if not (0 <= mb < self.micro_batches):
+                yield cmds
+                continue
+            buf = self._buffer_idx(mb)
+            if self.is_first_stage:
+                cmds.append(LoadMicroBatch(buf))
+            else:
+                cmds.append(RecvActivation(buf))
+            cmds.append(ForwardPass(buf))
+            if not self.is_last_stage:
+                cmds.append(SendActivation(buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: warmup forwards, steady-state alternation, drain backwards, then reduce+step.
+
+    Invariants (tested in ``tests/unit/runtime/pipe/test_pipe_schedule.py``): every microbatch is
+    forwarded then backwarded exactly once per stage; a stage never has more than
+    ``stages - stage_id`` microbatches in flight; sends/recvs pair up across adjacent stages.
+    """
+
+    def num_pipe_buffers(self) -> int:
+        # 1F1B keeps at most (stages - stage_id) microbatches in flight on this stage.
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+    def steps(self):
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        warmup = min(S - s - 1, M)
+        fwd_done = 0
+        bwd_done = 0
+        # Each stage starts its local step stream offset by its depth so that cross-stage
+        # send/recv pairs align step-for-step when all streams are laid side by side.
+        for _ in range(s):
+            yield []  # idle while the wavefront reaches this stage
+
+        # warmup: forwards only
+        for _ in range(warmup):
+            cmds: List[PipeInstruction] = []
+            buf = self._buffer_idx(fwd_done)
+            if self.is_first_stage:
+                cmds.append(LoadMicroBatch(buf))
+            else:
+                cmds.append(RecvActivation(buf))
+            cmds.append(ForwardPass(buf))
+            if not self.is_last_stage:
+                cmds.append(SendActivation(buf))
+            fwd_done += 1
+            yield cmds
+
+        # steady state: one forward, one backward per round
+        while fwd_done < M:
+            cmds = []
+            buf = self._buffer_idx(fwd_done)
+            if self.is_first_stage:
+                cmds.append(LoadMicroBatch(buf))
+            else:
+                cmds.append(RecvActivation(buf))
+            cmds.append(ForwardPass(buf))
+            if not self.is_last_stage:
+                cmds.append(SendActivation(buf))
+            fwd_done += 1
+            yield cmds
+
+            cmds = []
+            bbuf = self._buffer_idx(bwd_done)
+            if not self.is_last_stage:
+                cmds.append(RecvGrad(bbuf))
+            cmds.append(BackwardPass(bbuf))
+            if not self.is_first_stage:
+                cmds.append(SendGrad(bbuf))
+            bwd_done += 1
+            yield cmds
+
+        # drain: remaining backwards
+        while bwd_done < M:
+            cmds = []
+            bbuf = self._buffer_idx(bwd_done)
+            if not self.is_last_stage:
+                cmds.append(RecvGrad(bbuf))
+            cmds.append(BackwardPass(bbuf))
+            if not self.is_first_stage:
+                cmds.append(SendGrad(bbuf))
+            bwd_done += 1
+            yield cmds
+
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule: plain gradient accumulation
+    (reference ``schedule.py:299``)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            yield [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]

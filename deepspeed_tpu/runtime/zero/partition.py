@@ -56,6 +56,8 @@ def infer_fsdp_spec(shape, fsdp_size: int, base_spec: Optional[P] = None,
     shape = tuple(shape)
     base = _spec_axes(base_spec)
     base = base + [None] * (len(shape) - len(base))
+    if any(entry is not None and AXIS_FSDP in entry for entry in base):
+        return P(*base)  # already fsdp-sharded (e.g. stage-3 param spec reused as base)
     if fsdp_size <= 1 or len(shape) == 0 or int(np.prod(shape)) < min_size:
         return P(*base) if base_spec is not None else P()
     best_dim, best_size = -1, 0
@@ -91,17 +93,30 @@ def param_specs(abstract_params: Any, mesh_spec: MeshSpec, zero_stage: int,
 
 
 def optimizer_state_specs(abstract_opt_state: Any, mesh_spec: MeshSpec,
-                          zero_stage: int) -> Any:
+                          zero_stage: int, abstract_params: Any = None,
+                          param_spec_tree: Any = None) -> Any:
     """PartitionSpec pytree for optimizer state: sharded from stage 1 up.
 
-    Scalars (step counters) replicate; moment tensors shard like stage-3 params.
+    Scalars (step counters) replicate. Moment tensors inherit the parameter's sharding
+    (pipe/TP/stage-3 fsdp) — matched by shape, since optimizer states mirror the param tree
+    leaf-for-leaf — and from stage 1 additionally shard a free dim over ``fsdp``.
     """
     fsdp = mesh_spec.size(AXIS_FSDP)
+    shape_to_spec = {}
+    if abstract_params is not None and param_spec_tree is not None:
+        p_leaves = jax.tree_util.tree_leaves(abstract_params)
+        s_leaves = jax.tree_util.tree_leaves(
+            param_spec_tree, is_leaf=lambda x: isinstance(x, P))
+        for leaf, spec in zip(p_leaves, s_leaves):
+            shape_to_spec.setdefault(tuple(getattr(leaf, "shape", ())), spec)
 
     def one(leaf):
-        shape = getattr(leaf, "shape", ())
+        shape = tuple(getattr(leaf, "shape", ()))
+        base = shape_to_spec.get(shape)
         if zero_stage >= 1 and len(shape) > 0:
-            return infer_fsdp_spec(shape, fsdp, None)
+            return infer_fsdp_spec(shape, fsdp, base)
+        if base is not None and len(shape) > 0:
+            return base
         return P()
 
     return jax.tree_util.tree_map(one, abstract_opt_state)
