@@ -1,3 +1,5 @@
 from .base import Model, from_flax
 from .gpt2 import (GPT2, GPT2Config, GPT2_PRESETS, cross_entropy_loss, gpt2_config,
                    gpt2_model, gpt2_param_specs)
+from .gpt2_moe import GPT2MoE, GPT2MoEConfig, gpt2_moe_model, gpt2_moe_param_specs
+from .gpt2_pipe import gpt2_pipeline_module

@@ -1,0 +1,150 @@
+"""GPT-2 + MoE — the DeepSpeed-MoE NLG architecture.
+
+Reference pattern: alternating dense/MoE transformer layers with top-1 gating and a scaled
+load-balancing loss (``docs/_posts/2021-12-09-deepspeed-moe-nlg.md``; layer wiring via
+``deepspeed.moe.layer.MoE``). Expert parallelism rides the ``expert`` mesh axis.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..moe.layer import MoE
+from ..ops.transformer.attention import get_attention_impl
+from .base import Model
+from .gpt2 import GPT2Config, cross_entropy_loss
+
+
+@dataclasses.dataclass
+class GPT2MoEConfig(GPT2Config):
+    num_experts: int = 8
+    moe_layer_interval: int = 2      # every k-th layer is MoE (reference alternates)
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = "RSample"
+    moe_loss_coef: float = 0.01
+    use_residual: bool = False
+
+
+class MoEBlock(nn.Module):
+    """Transformer block with an MoE FFN (attention identical to the dense Block)."""
+    config: GPT2MoEConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        attn = get_attention_impl(cfg.attention_impl)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn",
+                       kernel_init=nn.initializers.normal(cfg.init_std))(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, t, _ = q.shape
+        q = q.reshape(b, t, cfg.n_head, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_head, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_head, cfg.head_dim)
+        o = attn(q, k, v, causal=True)
+        o = o.reshape(b, t, cfg.n_embd)
+        proj_init = nn.initializers.normal(cfg.init_std / (2 * cfg.n_layer) ** 0.5)
+        o = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj", kernel_init=proj_init)(o)
+        x = x + o
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(cfg.dtype)
+        y, l_aux, exp_counts = MoE(
+            hidden_size=cfg.n_embd,
+            ffn_hidden_size=4 * cfg.n_embd,
+            num_experts=cfg.num_experts,
+            k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor,
+            eval_capacity_factor=cfg.eval_capacity_factor,
+            min_capacity=cfg.min_capacity,
+            noisy_gate_policy=cfg.noisy_gate_policy,
+            use_residual=cfg.use_residual,
+            dtype=cfg.dtype,
+            init_std=cfg.init_std,
+            name="moe")(h, deterministic=deterministic)
+        self.sow("losses", "moe_l_aux", l_aux)
+        return x + y
+
+
+class GPT2MoE(nn.Module):
+    config: GPT2MoEConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.config
+        b, t = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(cfg.init_std),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(cfg.init_std),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        x = wte[input_ids].astype(cfg.dtype) + wpe[:t][None].astype(cfg.dtype)
+
+        from .gpt2 import Block
+        for i in range(cfg.n_layer):
+            if (i + 1) % cfg.moe_layer_interval == 0:
+                x = MoEBlock(cfg, name=f"h_moe_{i}")(x, deterministic)
+            else:
+                x = Block(cfg, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return x.astype(jnp.float32) @ wte.T
+
+
+def gpt2_moe_model(config: GPT2MoEConfig, sample_seq_len: Optional[int] = None,
+                   sample_batch_size: int = 1) -> Model:
+    module = GPT2MoE(config)
+    t = sample_seq_len or config.n_positions
+
+    def init_fn(rng):
+        sample = jnp.zeros((sample_batch_size, t), dtype=jnp.int32)
+        return module.init({"params": rng, "gating": rng}, sample)["params"]
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        logits, mutables = module.apply(
+            {"params": params}, ids, deterministic=False,
+            rngs={"gating": rng, "dropout": jax.random.fold_in(rng, 1)},
+            mutable=["losses"])
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, dtype=ids.dtype)], axis=1)
+        lm_loss = cross_entropy_loss(logits, labels)
+        aux = jax.tree_util.tree_leaves(mutables.get("losses", {}))
+        moe_loss = (jnp.sum(jnp.stack([jnp.sum(a) for a in aux]))
+                    if aux else jnp.float32(0.0))
+        return lm_loss + config.moe_loss_coef * moe_loss
+
+    def apply_fn(params, batch, rng=None):
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return module.apply({"params": params}, ids, deterministic=True)
+
+    return Model(loss_fn=loss_fn, init_fn=init_fn, apply_fn=apply_fn,
+                 param_specs=None,
+                 name=f"GPT2MoE(L{config.n_layer},d{config.n_embd},E{config.num_experts})")
+
+
+def gpt2_moe_param_specs(params, expert_axis: str = "expert",
+                         tensor_axis: Optional[str] = None) -> Any:
+    """Expert params shard over ``expert`` (reference expert-parallel groups); gate + dense
+    params replicated (or TP-sharded by the dense rules if ``tensor_axis`` given)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def spec_for(path_str: str, ndim: int):
+        if "/experts/" in path_str or path_str.endswith(("w1", "b1", "w2", "b2")) \
+                and "experts" in path_str:
+            lead = [expert_axis] + [None] * (ndim - 1)
+            return P(*lead)
+        return P(*([None] * ndim)) if ndim else P()
+
+    specs = []
+    for path, leaf in flat:
+        path_str = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        specs.append(spec_for(path_str, getattr(leaf, "ndim", 0)))
+    return jax.tree_util.tree_unflatten(treedef, specs)

@@ -1,0 +1,6 @@
+from .experts import Experts, expert_param_specs
+from .layer import MoE
+from .mappings import drop_tokens, gather_tokens
+from .sharded_moe import (TopKGate, moe_dispatch_combine, top1gating, top2gating)
+from .utils import (is_moe_param_path, map_moe_params, split_moe_param_paths,
+                    split_params_into_different_moe_groups_for_optimizer)

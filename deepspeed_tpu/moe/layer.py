@@ -1,0 +1,77 @@
+"""MoE layer: gate + experts (+ optional dense residual branch).
+
+Reference ``deepspeed/moe/layer.py`` (``MoE:15``): wraps ``TopKGate`` + ``Experts`` +
+``MOELayer`` and optionally a dense "residual MoE" branch (DeepSpeed-MoE NLG design) mixed via
+a learned coefficient. Expert parallelism degree = size of the ``expert`` mesh axis; the
+reference's process-group plumbing (``_create_expert_and_data_parallel``) is replaced by the
+mesh axis + sharding constraints.
+"""
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .experts import Experts
+from .sharded_moe import TopKGate, moe_dispatch_combine
+
+
+class MoE(nn.Module):
+    """Sparse MoE FFN block: (..., m) → ((..., m), l_aux, exp_counts)."""
+    hidden_size: int
+    ffn_hidden_size: Optional[int] = None
+    num_experts: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None   # None | 'Jitter' | 'RSample'
+    drop_tokens: bool = True
+    use_rts: bool = True
+    top2_2nd_expert_sampling: bool = True
+    use_residual: bool = False
+    activation: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.bfloat16
+    init_std: float = 0.02
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        m = self.hidden_size
+        d_ff = self.ffn_hidden_size or 4 * m
+        orig_shape = x.shape
+        tokens = x.reshape(-1, m)
+
+        wg = self.param("gate_wg", nn.initializers.normal(self.init_std),
+                        (m, self.num_experts), jnp.float32)
+        gate = TopKGate(k=self.k, capacity_factor=self.capacity_factor,
+                        eval_capacity_factor=self.eval_capacity_factor,
+                        min_capacity=self.min_capacity,
+                        noisy_gate_policy=self.noisy_gate_policy,
+                        drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+                        top2_2nd_expert_sampling=self.top2_2nd_expert_sampling)
+        rng = (self.make_rng("gating")
+               if not deterministic and (self.noisy_gate_policy or self.use_rts)
+               else None)
+        l_aux, combine, dispatch, exp_counts = gate(
+            wg, tokens, train=not deterministic, rng=rng)
+
+        experts = Experts(num_experts=self.num_experts, d_model=m, d_ff=d_ff,
+                          activation=self.activation, dtype=self.dtype,
+                          init_std=self.init_std, name="experts")
+        y = moe_dispatch_combine(tokens, combine, dispatch, experts)
+
+        if self.use_residual:
+            # Residual MoE (reference ``layer.py:residual_mlp``): dense MLP branch mixed with
+            # the sparse branch through a learned 2-way coefficient.
+            dense = nn.Dense(d_ff, dtype=self.dtype, name="residual_fc1",
+                             kernel_init=nn.initializers.normal(self.init_std))(x)
+            dense = self.activation(dense)
+            dense = nn.Dense(m, dtype=self.dtype, name="residual_fc2",
+                             kernel_init=nn.initializers.normal(self.init_std))(dense)
+            coef = nn.Dense(2, dtype=jnp.float32, name="coefficient")(x)
+            coef = jax.nn.softmax(coef, axis=-1)
+            y = y.reshape(orig_shape) * coef[..., 0:1] + dense * coef[..., 1:2]
+            return y.astype(x.dtype), l_aux, exp_counts
+
+        return y.reshape(orig_shape).astype(x.dtype), l_aux, exp_counts

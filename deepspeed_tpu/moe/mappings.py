@@ -1,0 +1,35 @@
+"""Expert-tensor-parallel token mappings.
+
+Reference ``deepspeed/moe/mappings.py`` (``gather_tokens:27``, ``drop_tokens`` and their
+autograd duals): with expert TP enabled, tokens are gathered across the tensor axis before the
+expert computation and re-dropped after, so each TP rank sees the full token set.
+
+TPU-native: these are sharding-constraint changes on the sequence dim — XLA emits the
+all-gather / dynamic-slice pair; wrapped in ``custom_jvp``-free plain functions because the
+transpose of a sharding constraint is itself (collectives are linear).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import AXIS_TENSOR, get_global_mesh
+
+
+def gather_tokens(x: jnp.ndarray, dim: int = 0) -> jnp.ndarray:
+    """Make ``dim`` fully replicated across the tensor axis (all-gather)."""
+    mesh = get_global_mesh()
+    if mesh is None or mesh.size(AXIS_TENSOR) <= 1:
+        return x
+    spec = [None] * x.ndim
+    return jax.lax.with_sharding_constraint(x, mesh.sharding(P(*spec)))
+
+
+def drop_tokens(x: jnp.ndarray, dim: int = 0) -> jnp.ndarray:
+    """Shard ``dim`` across the tensor axis (each TP rank keeps its slice)."""
+    mesh = get_global_mesh()
+    if mesh is None or mesh.size(AXIS_TENSOR) <= 1:
+        return x
+    spec = [None] * x.ndim
+    spec[dim] = AXIS_TENSOR
+    return jax.lax.with_sharding_constraint(x, mesh.sharding(P(*spec)))

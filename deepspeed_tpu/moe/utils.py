@@ -1,0 +1,58 @@
+"""MoE parameter bookkeeping.
+
+Reference ``deepspeed/moe/utils.py``: ``is_moe_param``, ``split_params_into_different_moe_groups_for_optimizer:64``
+split expert vs non-expert params so ZeRO partitions them over the right process groups. In the
+mesh design the split is a PartitionSpec question: expert params shard over the ``expert`` axis
+and must NOT be additionally replicated-reduced over it. These helpers classify params by path
+so engines/optimizers can apply per-group behaviour (e.g. expert LR scaling, spec merging).
+"""
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def is_moe_param_path(path_str: str) -> bool:
+    return "experts" in path_str or "gate_wg" in path_str
+
+
+def split_moe_param_paths(params: Any) -> Tuple[List[str], List[str]]:
+    """Return (moe_paths, dense_paths) over the flattened param tree."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    moe, dense = [], []
+    for path, _ in flat:
+        p = _path_str(path)
+        (moe if is_moe_param_path(p) else dense).append(p)
+    return moe, dense
+
+
+def map_moe_params(params: Any, moe_fn: Callable, dense_fn: Callable) -> Any:
+    """tree_map with different fns for expert vs dense params (path-classified)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = [moe_fn(leaf) if is_moe_param_path(_path_str(path)) else dense_fn(leaf)
+           for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def split_params_into_different_moe_groups_for_optimizer(
+        param_groups: List[Dict]) -> List[Dict]:
+    """API shim matching the reference signature: split torch-style param groups into
+    moe/non-moe groups (the engine itself is group-free; this serves ported user code)."""
+    out = []
+    for group in param_groups:
+        params = group.get("params", [])
+        moe, dense = [], []
+        for p in params:
+            (moe if getattr(p, "allreduce", True) is False else dense).append(p)
+        g_dense = dict(group)
+        g_dense["params"] = dense
+        out.append(g_dense)
+        if moe:
+            g_moe = dict(group)
+            g_moe.update(params=moe, moe=True, name="moe")
+            out.append(g_moe)
+    return out

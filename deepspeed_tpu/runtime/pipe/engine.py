@@ -73,15 +73,15 @@ class PipelineEngine(DeepSpeedEngine):
     def eval_batch(self, batch, data_iter=None):
         """Pipelined forward-only evaluation (reference ``eval_batch:380``)."""
         if "pipe_eval" not in self._fns:
-            def eval_step(params, batch, rng):
+            def eval_step(params, batch):
                 from ..utils import tree_cast
+                # rng=None → deterministic pass (dropout off), reference eval semantics
                 return self.module.loss_fn(tree_cast(params, self.compute_dtype),
-                                           batch, rng)
+                                           batch, None)
             self._fns["pipe_eval"] = jax.jit(eval_step)
         local = self._reshape_for_gas(batch)
         gbatch = self._globalize(local, leading_gas=True)
-        rng = jax.random.fold_in(self._base_rng, 0x7FFFFFFF)
-        return self._fns["pipe_eval"](self.state.params, gbatch, rng)
+        return self._fns["pipe_eval"](self.state.params, gbatch)
 
     # Micro-step API is not meaningful when the pipeline consumes whole batches.
     def forward(self, *a, **kw):

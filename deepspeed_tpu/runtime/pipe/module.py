@@ -34,7 +34,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ...models.base import Model
 from ...parallel.mesh import AXIS_PIPE, MeshSpec
 from ...utils.logging import logger
 
@@ -228,7 +227,15 @@ class PipelineModule:
                     tied_abstract[key] = p
             self._abstract_params.append(p)
             leaves = jax.tree_util.tree_leaves(p)
-            sig = (str(jax.tree_util.tree_structure(p)),
+            # signature includes layer IDENTITY (type + wrapped-module repr), not just param
+            # shapes: two different layer types with coincidentally equal param trees must
+            # not be merged into one body and applied with the first layer's apply()
+            ident = type(layer).__name__
+            inner = getattr(layer, "module", None)
+            if inner is not None:
+                ident += ":" + repr(inner)
+            sig = (ident,
+                   str(jax.tree_util.tree_structure(p)),
                    tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
             shapes.append(sig)
             x = jax.eval_shape(partial(layer.apply), p, x, None)
@@ -438,9 +445,13 @@ class PipelineModule:
 
     # ------------------------------------------------------------------ model adapter
     def to_model(self, mesh_spec: Optional[MeshSpec] = None, name: str = "pipeline",
-                 remat: Optional[bool] = None) -> Model:
+                 remat: Optional[bool] = None):
         """Bundle into the engine's :class:`Model` contract. ``loss_fn`` consumes microbatched
-        batches ``(inputs, labels)`` with leading dim M and returns mean loss."""
+        batches ``(inputs, labels)`` with leading dim M and returns mean loss; ``rng=None``
+        runs a deterministic (dropout-off) pass."""
+        # imported here, not at module top: models/__init__ imports gpt2_pipe which imports
+        # this module — a top-level import would make the cycle order-dependent
+        from ...models.base import Model
         if remat is None:
             remat = self.activation_checkpoint_interval > 0
 
@@ -455,6 +466,21 @@ class PipelineModule:
             mesh = mesh_spec or _require_global_mesh()
             inputs, labels = split_batch(batch)
             M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+            if rng is None:  # deterministic pass (eval)
+                xs = jax.vmap(
+                    lambda inp: self._segment_apply(params, inp, None, 0, self.body_start)
+                )(inputs)
+                ys = self.pipelined_apply(params, xs, mesh, rng=None, remat=remat)
+
+                def tail_det(y, lab):
+                    out = self._segment_apply(params, y, None, self.body_end,
+                                              len(self._layers))
+                    if self.loss_fn is not None:
+                        return self.loss_fn(out, lab)
+                    return out if out.ndim == 0 else jnp.mean(out)
+
+                return jnp.mean(jax.vmap(tail_det)(ys, labels))
+
             pre_rngs = jax.random.split(jax.random.fold_in(rng, 1), M)
             xs = jax.vmap(
                 lambda inp, r: self._segment_apply(params, inp, r, 0, self.body_start)

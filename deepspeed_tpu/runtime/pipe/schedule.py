@@ -168,6 +168,26 @@ class TrainSchedule(PipeSchedule):
         # 1F1B keeps at most (stages - stage_id) microbatches in flight on this stage.
         return max(2, min(self.stages - self.stage_id, self.micro_batches))
 
+    def _fwd_cmds(self, micro_batch_id: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(micro_batch_id)
+        cmds: List[PipeInstruction] = [
+            LoadMicroBatch(buf) if self.is_first_stage else RecvActivation(buf),
+            ForwardPass(buf),
+        ]
+        if not self.is_last_stage:
+            cmds.append(SendActivation(buf))
+        return cmds
+
+    def _bwd_cmds(self, micro_batch_id: int) -> List[PipeInstruction]:
+        buf = self._buffer_idx(micro_batch_id)
+        cmds: List[PipeInstruction] = []
+        if not self.is_last_stage:
+            cmds.append(RecvGrad(buf))
+        cmds.append(BackwardPass(buf))
+        if not self.is_first_stage:
+            cmds.append(SendGrad(buf))
+        return cmds
+
     def steps(self):
         M, S, s = self.micro_batches, self.stages, self.stage_id
         warmup = min(S - s - 1, M)
@@ -178,55 +198,19 @@ class TrainSchedule(PipeSchedule):
         for _ in range(s):
             yield []  # idle while the wavefront reaches this stage
 
-        # warmup: forwards only
-        for _ in range(warmup):
-            cmds: List[PipeInstruction] = []
-            buf = self._buffer_idx(fwd_done)
-            if self.is_first_stage:
-                cmds.append(LoadMicroBatch(buf))
-            else:
-                cmds.append(RecvActivation(buf))
-            cmds.append(ForwardPass(buf))
-            if not self.is_last_stage:
-                cmds.append(SendActivation(buf))
+        for _ in range(warmup):  # fill: forwards only
+            yield self._fwd_cmds(fwd_done)
             fwd_done += 1
-            yield cmds
 
-        # steady state: one forward, one backward per round
-        while fwd_done < M:
-            cmds = []
-            buf = self._buffer_idx(fwd_done)
-            if self.is_first_stage:
-                cmds.append(LoadMicroBatch(buf))
-            else:
-                cmds.append(RecvActivation(buf))
-            cmds.append(ForwardPass(buf))
-            if not self.is_last_stage:
-                cmds.append(SendActivation(buf))
+        while fwd_done < M:  # steady state: one forward, one backward per round
+            yield self._fwd_cmds(fwd_done)
             fwd_done += 1
-            yield cmds
-
-            cmds = []
-            bbuf = self._buffer_idx(bwd_done)
-            if not self.is_last_stage:
-                cmds.append(RecvGrad(bbuf))
-            cmds.append(BackwardPass(bbuf))
-            if not self.is_first_stage:
-                cmds.append(SendGrad(bbuf))
+            yield self._bwd_cmds(bwd_done)
             bwd_done += 1
-            yield cmds
 
-        # drain: remaining backwards
-        while bwd_done < M:
-            cmds = []
-            bbuf = self._buffer_idx(bwd_done)
-            if not self.is_last_stage:
-                cmds.append(RecvGrad(bbuf))
-            cmds.append(BackwardPass(bbuf))
-            if not self.is_first_stage:
-                cmds.append(SendGrad(bbuf))
+        while bwd_done < M:  # drain: remaining backwards
+            yield self._bwd_cmds(bwd_done)
             bwd_done += 1
-            yield cmds
 
         yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
 

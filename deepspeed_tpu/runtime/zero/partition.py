@@ -102,24 +102,36 @@ def optimizer_state_specs(abstract_opt_state: Any, mesh_spec: MeshSpec,
     leaf-for-leaf — and from stage 1 additionally shard a free dim over ``fsdp``.
     """
     fsdp = mesh_spec.size(AXIS_FSDP)
-    shape_to_spec = {}
-    if abstract_params is not None and param_spec_tree is not None:
-        p_leaves = jax.tree_util.tree_leaves(abstract_params)
-        s_leaves = jax.tree_util.tree_leaves(
-            param_spec_tree, is_leaf=lambda x: isinstance(x, P))
-        for leaf, spec in zip(p_leaves, s_leaves):
-            shape_to_spec.setdefault(tuple(getattr(leaf, "shape", ())), spec)
 
-    def one(leaf):
+    def finalize(leaf, base):
         shape = tuple(getattr(leaf, "shape", ()))
-        base = shape_to_spec.get(shape)
         if zero_stage >= 1 and len(shape) > 0:
             return infer_fsdp_spec(shape, fsdp, base)
         if base is not None and len(shape) > 0:
             return base
         return P()
 
-    return jax.tree_util.tree_map(one, abstract_opt_state)
+    if abstract_params is None or param_spec_tree is None:
+        return jax.tree_util.tree_map(lambda l: finalize(l, None), abstract_opt_state)
+
+    # Optimizer moments mirror the param tree leaf-for-leaf (e.g. AdamState.exp_avg): match
+    # by TREE STRUCTURE, which is exact — shape-based matching would confuse same-shaped
+    # params with different specs.
+    param_treedef = jax.tree_util.tree_structure(abstract_params)
+
+    def mirrors_params(subtree) -> bool:
+        try:
+            return jax.tree_util.tree_structure(subtree) == param_treedef
+        except Exception:
+            return False
+
+    def handle(subtree):
+        if mirrors_params(subtree):
+            return jax.tree_util.tree_map(finalize, subtree, param_spec_tree,
+                                          is_leaf=lambda x: isinstance(x, P))
+        return jax.tree_util.tree_map(lambda l: finalize(l, None), subtree)
+
+    return jax.tree_util.tree_map(handle, abstract_opt_state, is_leaf=mirrors_params)
 
 
 def grad_accum_specs(abstract_params: Any, mesh_spec: MeshSpec, zero_stage: int,
