@@ -48,10 +48,15 @@ class MoEBlock(nn.Module):
         q = q.reshape(b, t, cfg.n_head, cfg.head_dim)
         k = k.reshape(b, t, cfg.n_head, cfg.head_dim)
         v = v.reshape(b, t, cfg.n_head, cfg.head_dim)
-        o = attn(q, k, v, causal=True)
+        drop_rng = (None if deterministic or cfg.dropout == 0.0
+                    else self.make_rng("dropout"))
+        o = attn(q, k, v, causal=True,
+                 dropout_rate=0.0 if deterministic else cfg.dropout,
+                 dropout_rng=drop_rng)
         o = o.reshape(b, t, cfg.n_embd)
         proj_init = nn.initializers.normal(cfg.init_std / (2 * cfg.n_layer) ** 0.5)
         o = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj", kernel_init=proj_init)(o)
+        o = nn.Dropout(cfg.dropout, deterministic=deterministic)(o)
         x = x + o
 
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(cfg.dtype)
@@ -69,6 +74,7 @@ class MoEBlock(nn.Module):
             init_std=cfg.init_std,
             name="moe")(h, deterministic=deterministic)
         self.sow("losses", "moe_l_aux", l_aux)
+        y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
         return x + y
 
 
@@ -84,6 +90,7 @@ class GPT2MoE(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(cfg.init_std),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
         x = wte[input_ids].astype(cfg.dtype) + wpe[:t][None].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
 
         from .gpt2 import Block
         for i in range(cfg.n_layer):
@@ -132,19 +139,30 @@ def gpt2_moe_model(config: GPT2MoEConfig, sample_seq_len: Optional[int] = None,
 
 def gpt2_moe_param_specs(params, expert_axis: str = "expert",
                          tensor_axis: Optional[str] = None) -> Any:
-    """Expert params shard over ``expert`` (reference expert-parallel groups); gate + dense
-    params replicated (or TP-sharded by the dense rules if ``tensor_axis`` given)."""
+    """Expert params shard over ``expert`` (reference expert-parallel groups); the gate stays
+    replicated; dense params follow the Megatron TP rules of ``gpt2_param_specs`` when
+    ``tensor_axis`` is given, else replicate. Classification reuses
+    ``moe.utils.is_moe_param_path`` so spec building and optimizer grouping agree."""
+    from ..moe.utils import _path_str, is_moe_param_path
+    from .gpt2 import gpt2_param_specs
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-
-    def spec_for(path_str: str, ndim: int):
-        if "/experts/" in path_str or path_str.endswith(("w1", "b1", "w2", "b2")) \
-                and "experts" in path_str:
-            lead = [expert_axis] + [None] * (ndim - 1)
-            return P(*lead)
-        return P(*([None] * ndim)) if ndim else P()
+    dense_spec_tree = (gpt2_param_specs(params, tensor_axis=tensor_axis)
+                       if tensor_axis is not None else None)
+    dense_specs = (jax.tree_util.tree_leaves(
+        dense_spec_tree, is_leaf=lambda x: isinstance(x, P))
+        if dense_spec_tree is not None else None)
 
     specs = []
-    for path, leaf in flat:
-        path_str = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        specs.append(spec_for(path_str, getattr(leaf, "ndim", 0)))
+    for idx, (path, leaf) in enumerate(flat):
+        path_str = _path_str(path)
+        ndim = getattr(leaf, "ndim", 0)
+        if is_moe_param_path(path_str):
+            if "experts" in path_str:
+                specs.append(P(expert_axis, *([None] * (ndim - 1))))
+            else:  # gate_wg: replicated (tiny)
+                specs.append(P(*([None] * ndim)) if ndim else P())
+        elif dense_specs is not None:
+            specs.append(dense_specs[idx])
+        else:
+            specs.append(P(*([None] * ndim)) if ndim else P())
     return jax.tree_util.tree_unflatten(treedef, specs)

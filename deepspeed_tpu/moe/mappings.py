@@ -17,19 +17,25 @@ from ..parallel.mesh import AXIS_TENSOR, get_global_mesh
 
 
 def gather_tokens(x: jnp.ndarray, dim: int = 0) -> jnp.ndarray:
-    """Make ``dim`` fully replicated across the tensor axis (all-gather)."""
+    """Replicate ``dim`` across the tensor axis (all-gather over TP only).
+
+    Other dims stay UNCONSTRAINED so existing data/expert sharding is preserved — the
+    reference gathers over the tensor-parallel group alone, never the DP group.
+    """
     mesh = get_global_mesh()
     if mesh is None or mesh.size(AXIS_TENSOR) <= 1:
         return x
-    spec = [None] * x.ndim
+    spec = [P.UNCONSTRAINED] * x.ndim
+    spec[dim] = None
     return jax.lax.with_sharding_constraint(x, mesh.sharding(P(*spec)))
 
 
 def drop_tokens(x: jnp.ndarray, dim: int = 0) -> jnp.ndarray:
-    """Shard ``dim`` across the tensor axis (each TP rank keeps its slice)."""
+    """Shard ``dim`` across the tensor axis (each TP rank keeps its slice);
+    other dims stay UNCONSTRAINED."""
     mesh = get_global_mesh()
     if mesh is None or mesh.size(AXIS_TENSOR) <= 1:
         return x
-    spec = [None] * x.ndim
+    spec = [P.UNCONSTRAINED] * x.ndim
     spec[dim] = AXIS_TENSOR
     return jax.lax.with_sharding_constraint(x, mesh.sharding(P(*spec)))

@@ -55,7 +55,9 @@ def top1gating(logits: jnp.ndarray,
     """
     s, e = logits.shape
     if capacity is None:
-        capacity = _capacity(s, e, capacity_factor, min_capacity)
+        # drop_tokens=False must not drop: use the static upper bound (reference expands
+        # capacity to max(exp_counts); s is the shape-static equivalent under jit)
+        capacity = _capacity(s, e, capacity_factor, min_capacity) if drop_tokens else s
 
     if noisy_gate_policy == "RSample" and rng is not None:
         noise = jax.random.gumbel(jax.random.fold_in(rng, 1), logits.shape)
@@ -113,7 +115,8 @@ def top2gating(logits: jnp.ndarray,
     probabilities renormalised; capacity doubled (k=2)."""
     s, e = logits.shape
     if capacity is None:
-        capacity = _capacity(s, e, 2.0 * capacity_factor, min_capacity)
+        capacity = (_capacity(s, e, 2.0 * capacity_factor, min_capacity)
+                    if drop_tokens else 2 * s)
 
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=1)
     idx1 = jnp.argmax(gates, axis=1)

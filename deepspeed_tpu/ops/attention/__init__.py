@@ -1,0 +1,3 @@
+from .decode import decode_attention, decode_attention_xla
+from .flash import flash_attention
+from .ring import ring_attention

@@ -1,0 +1,158 @@
+"""Fused decode attention with KV cache — the inference hot loop.
+
+TPU-native equivalent of the reference's ``softmax_context`` inference kernel
+(``csrc/transformer/inference/csrc/softmax.cu`` + KV-cache layout in ``transform.cu``, bound as
+``softmax_context`` in ``pt_binding.cpp``): one kernel computes a single decode step's
+attention over the cache with online softmax, masked by the per-sequence cache length —
+no (T,) score materialisation in HBM, no dynamic shapes (the cache is a fixed-capacity buffer).
+
+The cache is stored HEAD-MAJOR ``(b, h_kv, T, d)`` — the same layout transformation the
+reference performs in ``transform.cu`` — so each kv head's cache block is contiguous and the
+per-head matmuls batch cleanly on the MXU. Supports grouped-query attention (``h_kv <= h``) by
+batching the q heads of each kv group into one matmul.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(len_ref, q_ref, k_hbm, v_hbm, o_ref, *, block_k, scale):
+    """q_ref: (1, hk, g, d) VMEM; k/v_hbm: (b, hk, T, d) in HBM (DMA'd blockwise);
+    len_ref: scalar-prefetch (b,). Double-buffered DMA overlaps cache reads with compute —
+    the cache never fits VMEM (the reason the reference streams its KV cache too)."""
+    i = pl.program_id(0)
+    L = len_ref[i]
+    q = q_ref[0].astype(jnp.float32)            # (hk, g, d)
+    hk, g, d = q.shape
+    nk = pl.cdiv(L, block_k)                    # dynamic: only touch valid cache blocks
+
+    def scoped(k_buf, v_buf, ksem, vsem):
+        def k_dma(slot, kb):
+            return pltpu.make_async_copy(
+                k_hbm.at[i, :, pl.ds(kb * block_k, block_k), :], k_buf.at[slot],
+                ksem.at[slot])
+
+        def v_dma(slot, kb):
+            return pltpu.make_async_copy(
+                v_hbm.at[i, :, pl.ds(kb * block_k, block_k), :], v_buf.at[slot],
+                vsem.at[slot])
+
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+        def body(kb, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(kb, 2)
+            nxt = jax.lax.rem(kb + 1, 2)
+
+            @pl.when(kb + 1 < nk)
+            def _():
+                k_dma(nxt, kb + 1).start()
+                v_dma(nxt, kb + 1).start()
+
+            k_dma(slot, kb).wait()
+            v_dma(slot, kb).wait()
+            k_blk = k_buf[slot].astype(jnp.float32)   # (hk, bk, d)
+            v_blk = v_buf[slot].astype(jnp.float32)
+            # (hk, g, d) x (hk, bk, d) -> (hk, g, bk), batched over kv heads
+            s = jax.lax.dot_general(
+                q, k_blk,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) * scale
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (hk, g, block_k), 2)
+            s = jnp.where(cols < L, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            # (hk, g, bk) x (hk, bk, d) -> (hk, g, d)
+            acc_new = acc * alpha[..., None] + jax.lax.dot_general(
+                p, v_blk,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((hk, g), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((hk, g), jnp.float32)
+        acc0 = jnp.zeros((hk, g, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc / l_safe[..., None]).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        k_buf=pltpu.VMEM((2, hk, block_k, d), k_hbm.dtype),
+        v_buf=pltpu.VMEM((2, hk, block_k, d), v_hbm.dtype),
+        ksem=pltpu.SemaphoreType.DMA((2,)),
+        vsem=pltpu.SemaphoreType.DMA((2,)),
+    )
+
+
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                     cache_len: jnp.ndarray, softmax_scale=None,
+                     block_k: int = 128) -> jnp.ndarray:
+    """One decode step of attention against the cache.
+
+    q: ``(b, h, d)`` (current position); k_cache/v_cache: ``(b, h_kv, T, d)`` head-major
+    fixed-capacity; cache_len: ``(b,)`` valid lengths (the current position is already
+    written to the cache). Returns ``(b, h, d)``.
+    """
+    b, h, d = q.shape
+    hk, T = k_cache.shape[1], k_cache.shape[2]
+    assert h % hk == 0, f"query heads {h} must be a multiple of kv heads {hk}"
+    g = h // hk
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    bk = min(block_k, T)
+    while T % bk:
+        bk //= 2
+    q4 = q.reshape(b, hk, g, d)
+    lens = cache_len.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hk, g, d), lambda i, lens_ref: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # cache stays in HBM, DMA'd blockwise
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, hk, g, d), lambda i, lens_ref: (i, 0, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=bk, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, g, d), q.dtype),
+        interpret=_interpret(),
+    )(lens, q4, k_cache, v_cache)
+    return out.reshape(b, h, d)
+
+
+def decode_attention_xla(q, k_cache, v_cache, cache_len, softmax_scale=None):
+    """jnp reference implementation (ground truth for kernel tests; fallback path).
+
+    Same head-major cache layout ``(b, h_kv, T, d)`` as the kernel."""
+    b, h, d = q.shape
+    hk, T = k_cache.shape[1], k_cache.shape[2]
+    g = h // hk
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    q4 = q.reshape(b, hk, g, d).astype(jnp.float32)
+    k = k_cache.astype(jnp.float32)
+    v = v_cache.astype(jnp.float32)
+    s = jnp.einsum("bkgd,bktd->bkgt", q4, k) * scale
+    mask = jnp.arange(T)[None, None, None, :] < cache_len[:, None, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,bktd->bkgd", p, v)
+    return o.reshape(b, h, d).astype(q.dtype)

@@ -1,0 +1,322 @@
+"""Flash attention — Pallas TPU kernel, forward + backward.
+
+TPU-native replacement for the reference's fused attention kernels
+(``csrc/transformer/softmax_kernels.cu`` ``attn_softmax``/``softmax_backward`` + the strided
+batch gemms in ``csrc/transformer/ds_transformer_cuda.cpp``): one kernel computes the whole
+attention block with online softmax, never materialising the (t × t) score matrix in HBM —
+the memory behaviour the reference approximates with kernel fusion, taken to its fixed point.
+
+Algorithm: standard flash attention v2 tiling. Forward keeps running (max, sum, acc) per
+q-row-block while streaming k/v blocks through VMEM; saves per-row logsumexp for the backward.
+Backward recomputes probabilities blockwise from the saved logsumexp (dq kernel gridded over
+q blocks, dk/dv kernel gridded over k blocks) — no stored attention matrix, matching the
+activation-memory profile that makes long sequences feasible.
+
+On CPU (tests) kernels run in interpreter mode automatically.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(t: int, block_q: int, block_k: int):
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    while t % bq:
+        bq //= 2
+    while t % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+# ----------------------------------------------------------------------- forward kernel
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, t_valid):
+    q = q_ref[0].astype(jnp.float32)          # (bq, d)
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    j = pl.program_id(1)
+    q_start = j * bq
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    nk = t // block_k
+    if causal:
+        # process only blocks intersecting the causal triangle
+        k_hi = jax.lax.div(q_start + bq + block_k - 1, block_k)
+        k_hi = jnp.minimum(k_hi, nk)
+    else:
+        k_hi = nk
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        mask = cols < t_valid
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((bq,), dtype=jnp.float32)
+    acc0 = jnp.zeros((bq, d), dtype=jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, k_hi, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse stored (bh, nq, 8, bq): TPU block tiling needs the last two dims (sublane, lane)
+    # aligned to (8, 128); the 8 duplicate sublanes cost t*32B and keep the layout legal
+    lse = (m + jnp.log(l_safe)).astype(jnp.float32)
+    lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid):
+    """q3/k3/v3: (bh, t, d) padded to block multiples. Returns (o3, lse (bh, t))."""
+    bh, t, d = q3.shape
+    bq, bk = _block_sizes(t, block_q, block_k)
+    grid = (bh, t // bq)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=bk, t_valid=t_valid)
+    o3, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, t // bq, 8, bq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return o3, lse[:, :, 0, :].reshape(bh, t)
+
+
+# ---------------------------------------------------------------------- backward kernels
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k, t_valid):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, 0]
+    delta = delta_ref[0, 0, 0]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    j = pl.program_id(1)
+    q_start = j * bq
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    nk = t // block_k
+    if causal:
+        k_hi = jnp.minimum(jax.lax.div(q_start + bq + block_k - 1, block_k), nk)
+    else:
+        k_hi = nk
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        mask = cols < t_valid
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                      # true probs
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, k_hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    *, scale, causal, block_q, t_valid):
+    k_blk = k_ref[0].astype(jnp.float32)      # (bk, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    bk, d = k_blk.shape
+    t = q_ref.shape[1]
+    kb = pl.program_id(1)
+    k_start = kb * bk
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    nq = t // block_q
+    q_lo = jax.lax.div(k_start, block_q) if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, qb, 0]                           # (block_q,)
+        delta_blk = delta_ref[0, qb, 0]
+        s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        mask = cols < t_valid
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk = dk + jax.lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, t_valid):
+    bh, t, d = q3.shape
+    bq, bk = _block_sizes(t, block_q, block_k)
+    nq = t // bq
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # (bh, t)
+    lse_b = jnp.broadcast_to(lse.reshape(bh, nq, 1, bq), (bh, nq, 8, bq))
+    delta_b = jnp.broadcast_to(delta.reshape(bh, nq, 1, bq), (bh, nq, 8, bq))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, block_k=bk,
+                          t_valid=t_valid),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse_b, delta_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
+                          t_valid=t_valid),
+        grid=(bh, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, nq, 8, bq), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((1, nq, 8, bq), lambda i, j: (i, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse_b, delta_b)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- public op
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q3, k3, v3, scale, causal, block_q, block_k):
+    t_valid = q3.shape[1]
+    o3, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid)
+    return o3
+
+
+def _flash_core_fwd(q3, k3, v3, scale, causal, block_q, block_k):
+    t_valid = q3.shape[1]
+    o3, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid)
+    return o3, (q3, k3, v3, o3, lse)
+
+
+def _flash_core_bwd(scale, causal, block_q, block_k, res, do3):
+    q3, k3, v3, o3, lse = res
+    t_valid = q3.shape[1]
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal,
+                            block_q, block_k, t_valid)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True, mask: Optional[jnp.ndarray] = None,
+                    softmax_scale: Optional[float] = None,
+                    dropout_rate: float = 0.0, dropout_rng=None,
+                    block_q: int = 128, block_k: int = 128) -> jnp.ndarray:
+    """Drop-in replacement for ``xla_attention``: q/k/v ``(b, t, h, d)`` → ``(b, t, h, d)``.
+
+    Falls back to the XLA path for features the kernel does not cover (arbitrary masks,
+    attention dropout, cross-attention with different kv length).
+    """
+    from ..transformer.attention import xla_attention
+    if mask is not None or dropout_rate > 0.0 or q.shape[1] != k.shape[1]:
+        return xla_attention(q, k, v, causal=causal, mask=mask,
+                             softmax_scale=softmax_scale,
+                             dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+    b, t, h, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+
+    def local(q4, k4, v4):
+        lb, lt, lh, ld = q4.shape
+
+        def to3(x):
+            return x.transpose(0, 2, 1, 3).reshape(lb * lh, lt, ld)
+
+        o3 = _flash_core(to3(q4), to3(k4), to3(v4), scale, causal, block_q, block_k)
+        return o3.reshape(lb, lh, lt, ld).transpose(0, 2, 1, 3)
+
+    # A pallas_call is opaque to the SPMD partitioner: under a sharded mesh it would force a
+    # full rematerialisation. Run the kernel per-shard with shard_map over the batch (and TP
+    # head) axes instead — sequence stays unsharded here (ring_attention owns the seq axis).
+    from ...parallel.mesh import BATCH_AXES, AXIS_TENSOR, get_global_mesh
+    mesh = get_global_mesh()
+    if mesh is not None:
+        batch_axes = tuple(ax for ax in BATCH_AXES if mesh.size(ax) > 1)
+        bsz = int(np.prod([mesh.size(ax) for ax in batch_axes])) if batch_axes else 1
+        tp = mesh.size(AXIS_TENSOR)
+        use_tp = tp > 1 and h % tp == 0
+        manual = set(batch_axes) | ({AXIS_TENSOR} if use_tp else set())
+        if manual and b % max(bsz, 1) == 0:
+            spec = P(batch_axes or None, None, AXIS_TENSOR if use_tp else None, None)
+            mapped = jax.shard_map(local, mesh=mesh.mesh, axis_names=manual,
+                                   in_specs=(spec,) * 3, out_specs=spec,
+                                   check_vma=False)
+            return mapped(q, k, v)
+    return local(q, k, v)

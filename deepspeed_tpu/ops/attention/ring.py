@@ -1,0 +1,109 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference snapshot has NO sequence parallelism (SURVEY §2.3: SP/CP/ring/Ulysses absent —
+its long-sequence story is block-sparse attention + curriculum). Here it is first-class: the
+sequence dim shards over the ``seq`` axis, K/V chunks rotate around the ring via
+``jax.lax.ppermute`` (compiled onto the ICI torus) while each device accumulates attention for
+its local Q chunk with online-softmax (log-sum-exp) merging — so attention memory per device is
+O(t/S · t/S) per step and activations never materialise the full sequence anywhere.
+
+The per-step chunk attention is XLA einsum+softmax (fused); each ring step is rematerialised
+in the backward. Gradients flow through the transposed permutes automatically — the backward
+ring runs in the reverse direction, which is exactly the ring-attention backward algorithm.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.mesh import AXIS_SEQ, get_global_mesh
+
+NEG_BIG = -1e30
+
+
+def _chunk_attn(q, k, v, rows0, cols0, causal, scale):
+    """Unnormalised blockwise attention: returns (acc, m, l) for LSE merging.
+
+    q: (b, tl, h, d); k/v: (b, tc, h, d); rows0/cols0: global offsets of the chunks.
+    """
+    tl, tc = q.shape[1], k.shape[1]
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        rows = rows0 + jax.lax.broadcasted_iota(jnp.int32, (tl, tc), 0)
+        cols = cols0 + jax.lax.broadcasted_iota(jnp.int32, (tl, tc), 1)
+        s = jnp.where((cols <= rows)[None, None], s, NEG_BIG)
+    m = jnp.max(s, axis=-1)                                   # (b, h, tl)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= NEG_BIG / 2, 0.0, p)                   # fully-masked rows stay 0
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhts,bshd->bhtd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   causal: bool = True, mask: Optional[jnp.ndarray] = None,
+                   softmax_scale: Optional[float] = None,
+                   dropout_rate: float = 0.0, dropout_rng=None,
+                   axis_name: str = AXIS_SEQ, mesh_spec=None) -> jnp.ndarray:
+    """Drop-in attention: q/k/v ``(b, t, h, d)`` with ``t`` sharded over ``seq``.
+
+    Falls back to flash attention when the mesh has no seq axis (or features the ring path
+    does not cover are requested)."""
+    from .flash import flash_attention
+    mesh = mesh_spec or get_global_mesh()
+    if (mesh is None or mesh.size(axis_name) <= 1 or mask is not None
+            or dropout_rate > 0.0):
+        return flash_attention(q, k, v, causal=causal, mask=mask,
+                               softmax_scale=softmax_scale,
+                               dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+
+    b, t, h, d = q.shape
+    S = mesh.size(axis_name)
+    assert t % S == 0, f"seq len {t} must divide the seq axis {S}"
+    tl = t // S
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    perm = [(r, (r + 1) % S) for r in range(S)]
+
+    def ring_fn(q_l, k_l, v_l):
+        # local chunks (b, tl, h, d)
+        s_idx = jax.lax.axis_index(axis_name)
+        rows0 = s_idx * tl
+
+        def step(carry, i):
+            m_run, l_run, acc, k_c, v_c = carry
+            owner = (s_idx - i) % S       # which global chunk this k/v is
+            cols0 = owner * tl
+            acc_c, m_c, l_c = _chunk_attn(q_l, k_c, v_c, rows0, cols0, causal, scale)
+            m_new = jnp.maximum(m_run, m_c)
+            a_run = jnp.exp(m_run - m_new)
+            a_c = jnp.exp(m_c - m_new)
+            acc = acc * a_run[..., None] + acc_c * a_c[..., None]
+            l_new = l_run * a_run + l_c * a_c
+            # rotate k/v to the next device (backward runs the reverse ring)
+            k_n = jax.lax.ppermute(k_c, axis_name, perm)
+            v_n = jax.lax.ppermute(v_c, axis_name, perm)
+            return (m_new, l_new, acc, k_n, v_n), None
+
+        m0 = jnp.full((b, h, tl), NEG_BIG, jnp.float32)
+        l0 = jnp.zeros((b, h, tl), jnp.float32)
+        acc0 = jnp.zeros((b, h, tl, d), jnp.float32)
+        (m_f, l_f, acc_f, _, _), _ = jax.lax.scan(
+            jax.checkpoint(step), (m0, l0, acc0, k_l, v_l), jnp.arange(S))
+        l_safe = jnp.where(l_f > 0, l_f, 1.0)
+        o = (acc_f / l_safe[..., None]).transpose(0, 2, 1, 3)  # (b, tl, h, d)
+        return o.astype(q_l.dtype)
+
+    mapped = jax.shard_map(
+        ring_fn,
+        mesh=mesh.mesh,
+        axis_names={axis_name},
+        in_specs=(P(None, axis_name, None, None),) * 3,
+        out_specs=P(None, axis_name, None, None),
+        check_vma=False,
+    )
+    return mapped(q, k, v)
