@@ -87,3 +87,6 @@ def add_config_arguments(parser):
 def argparse_suppress():
     import argparse
     return argparse.SUPPRESS
+
+from . import inference  # noqa: F401,E402  (init_inference config surface)
+from . import moe  # noqa: F401,E402

@@ -1,0 +1,2 @@
+from .config import DeepSpeedInferenceConfig, DeepSpeedTPConfig
+from .engine import InferenceEngine

@@ -1,0 +1,59 @@
+"""Inference configuration.
+
+Mirrors reference ``deepspeed/inference/config.py`` (``DeepSpeedInferenceConfig:125``,
+``DeepSpeedTPConfig``): same knob names so reference configs port over; TPU-only knobs
+(mesh data axis for throughput batching) added.
+"""
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from ..config.config_utils import ConfigModel
+
+
+class DeepSpeedTPConfig(ConfigModel):
+    """Reference ``DeepSpeedTPConfig``: tensor-parallel degree."""
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class QuantConfig(ConfigModel):
+    enabled: bool = False
+    bits: int = 8
+
+
+class InferenceCheckpointConfig(ConfigModel):
+    checkpoint_dir: Optional[str] = None
+    tag: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(ConfigModel):
+    """Reference ``inference/config.py:125``. ``replace_with_kernel_inject`` is accepted and
+    means "use the fused decode path" (always on here — it is the only path)."""
+    dtype: str = "bfloat16"                       # reference default fp16; bf16 on TPU
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig)
+    data_parallel: int = 1                        # extra throughput axis (TPU addition)
+    max_out_tokens: int = 1024                    # reference max_out_tokens
+    max_batch_size: int = 1
+    replace_with_kernel_inject: bool = True
+    quant: QuantConfig = Field(default_factory=QuantConfig)
+    checkpoint: Optional[Any] = None
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = False               # accepted; AOT decode is always compiled
+    min_out_tokens: int = 1
+
+    # convenience aliases the reference accepts at top level
+    mp_size: Optional[int] = None                 # deprecated alias of tensor_parallel.tp_size
+
+    def resolved_tp(self) -> int:
+        if self.mp_size is not None:
+            return int(self.mp_size)
+        return int(self.tensor_parallel.tp_size)
+
+    def jax_dtype(self):
+        import jax.numpy as jnp
+        return {"float32": jnp.float32, "fp32": jnp.float32,
+                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "int8": jnp.bfloat16}[str(self.dtype).replace("torch.", "")]

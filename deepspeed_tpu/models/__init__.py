@@ -1,4 +1,7 @@
 from .base import Model, from_flax
+from .causal_lm import (FAMILIES, CausalLM, CausalLMConfig, bloom_cfg, causal_lm_model,
+                        causal_lm_param_specs, gpt2_cfg, gptj_cfg, gptneox_cfg,
+                        init_cache, llama_cfg, opt_cfg)
 from .gpt2 import (GPT2, GPT2Config, GPT2_PRESETS, cross_entropy_loss, gpt2_config,
                    gpt2_model, gpt2_param_specs)
 from .gpt2_moe import GPT2MoE, GPT2MoEConfig, gpt2_moe_model, gpt2_moe_param_specs

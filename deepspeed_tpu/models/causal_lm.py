@@ -1,0 +1,458 @@
+"""Unified causal-LM family with KV-cache inference support.
+
+The TPU-native analogue of the reference's kernel-backed model implementations
+(``model_implementations/transformers/ds_{gpt,bloom,opt,megatron_gpt}.py`` +
+``module_inject/containers/``): instead of 12 per-architecture injection containers, ONE
+configurable transformer covers the families — positional scheme (learned/rotary/alibi),
+parallel residual, GQA, gated MLP, pre/post-LN — and per-family constructors pin the knobs.
+
+Two execution paths:
+- ``forward(params, ids)``: full-sequence logits (training/scoring, flash/xla attention);
+- ``prefill(params, ids)`` / ``decode_step(params, cache, tok)``: KV-cache serving path.
+  The cache is head-major ``(b, h_kv, T, d)`` feeding ``ops/attention/decode.py``'s fused
+  kernel (reference hot loop ``softmax_context``, ``csrc/transformer/inference``).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention.decode import decode_attention, decode_attention_xla
+from ..ops.transformer.attention import xla_attention
+from .base import Model
+
+
+@dataclasses.dataclass
+class CausalLMConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 2048
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: Optional[int] = None          # GQA; None → MHA
+    d_ff: Optional[int] = None               # None → 4*n_embd
+    pos_emb: str = "learned"                 # learned | rotary | alibi | none
+    rotary_pct: float = 1.0                  # NeoX partial rotary
+    rotary_base: float = 10000.0
+    parallel_residual: bool = False          # NeoX/GPT-J
+    gated_mlp: bool = False                  # LLaMA SwiGLU
+    activation: str = "gelu"                 # gelu | relu | silu
+    layernorm: str = "layernorm"             # layernorm | rmsnorm
+    ln_eps: float = 1e-5
+    embed_layernorm: bool = False            # BLOOM
+    tie_word_embeddings: bool = True
+    qkv_bias: bool = True
+    mlp_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    init_std: float = 0.02
+    name: str = "causal-lm"
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff or 4 * self.n_embd
+
+    def num_params(self) -> int:
+        d, L, v = self.n_embd, self.n_layer, self.vocab_size
+        f = self.ffn_dim
+        mlp = d * f * (3 if self.gated_mlp else 2)
+        attn = d * d + 2 * d * self.kv_heads * self.head_dim + d * d
+        return v * d + L * (attn + mlp) + (0 if self.tie_word_embeddings else v * d)
+
+
+# ---------------------------------------------------------------- family constructors
+def gpt2_cfg(**kw) -> CausalLMConfig:
+    return CausalLMConfig(pos_emb="learned", activation="gelu", name="gpt2", **kw)
+
+
+def bloom_cfg(**kw) -> CausalLMConfig:
+    """BLOOM (reference container ``module_inject/containers/bloom.py``): alibi positions,
+    embedding layernorm, tied head."""
+    kw.setdefault("pos_emb", "alibi")
+    kw.setdefault("embed_layernorm", True)
+    kw.setdefault("name", "bloom")
+    return CausalLMConfig(**kw)
+
+
+def opt_cfg(**kw) -> CausalLMConfig:
+    kw.setdefault("pos_emb", "learned")
+    kw.setdefault("activation", "relu")
+    kw.setdefault("name", "opt")
+    return CausalLMConfig(**kw)
+
+
+def gptneox_cfg(**kw) -> CausalLMConfig:
+    """GPT-NeoX (container ``gptneox.py``): rotary (partial), parallel residual."""
+    kw.setdefault("pos_emb", "rotary")
+    kw.setdefault("rotary_pct", 0.25)
+    kw.setdefault("parallel_residual", True)
+    kw.setdefault("tie_word_embeddings", False)
+    kw.setdefault("name", "gpt-neox")
+    return CausalLMConfig(**kw)
+
+
+def gptj_cfg(**kw) -> CausalLMConfig:
+    kw.setdefault("pos_emb", "rotary")
+    kw.setdefault("rotary_pct", 0.25)
+    kw.setdefault("parallel_residual", True)
+    kw.setdefault("name", "gptj")
+    return CausalLMConfig(**kw)
+
+
+def llama_cfg(**kw) -> CausalLMConfig:
+    kw.setdefault("pos_emb", "rotary")
+    kw.setdefault("gated_mlp", True)
+    kw.setdefault("activation", "silu")
+    kw.setdefault("layernorm", "rmsnorm")
+    kw.setdefault("qkv_bias", False)
+    kw.setdefault("mlp_bias", False)
+    kw.setdefault("tie_word_embeddings", False)
+    kw.setdefault("ln_eps", 1e-6)
+    kw.setdefault("name", "llama")
+    return CausalLMConfig(**kw)
+
+
+FAMILIES = {
+    "gpt2": gpt2_cfg, "bloom": bloom_cfg, "opt": opt_cfg,
+    "gpt_neox": gptneox_cfg, "gptj": gptj_cfg, "llama": llama_cfg,
+}
+
+
+# ----------------------------------------------------------------------- positional
+def alibi_slopes(n_head: int) -> np.ndarray:
+    """BLOOM alibi slope schedule (geometric in powers of 2)."""
+    closest = 2 ** int(np.floor(np.log2(n_head)))
+    base = 2.0 ** (-(2.0 ** -(np.log2(closest) - 3)))
+    slopes = base ** np.arange(1, closest + 1)
+    if closest < n_head:
+        extra_base = 2.0 ** (-(2.0 ** -(np.log2(2 * closest) - 3)))
+        extra = extra_base ** np.arange(1, 2 * (n_head - closest) + 1, 2)
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
+
+
+def rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(x, positions, base: float, pct: float):
+    """x: (b, t, h, d); positions: (b, t). Reference kernel:
+    ``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``."""
+    d = x.shape[-1]
+    rot = int(d * pct) // 2 * 2
+    if rot == 0:
+        return x
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    inv_freq = 1.0 / (base ** (np.arange(0, rot, 2, dtype=np.float32) / rot))
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq[None, None]  # (b,t,rot/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)[:, :, None, :]            # (b,t,1,rot)
+    cos, sin = jnp.cos(emb), jnp.sin(emb)
+    x_rot = x_rot.astype(jnp.float32)
+    out = x_rot * cos + rotate_half(x_rot) * sin
+    return jnp.concatenate([out.astype(x.dtype), x_pass], axis=-1)
+
+
+def _norm(cfg: CausalLMConfig, name: str):
+    if cfg.layernorm == "rmsnorm":
+        return nn.RMSNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name=name)
+    return nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name=name)
+
+
+def _act(cfg: CausalLMConfig):
+    return {"gelu": partial(nn.gelu, approximate=True), "relu": nn.relu,
+            "silu": nn.silu}[cfg.activation]
+
+
+# ----------------------------------------------------------------------- modules
+class CausalLMLayer(nn.Module):
+    config: CausalLMConfig
+
+    def _attn_proj(self, x):
+        cfg = self.config
+        hd, hk = cfg.head_dim, cfg.kv_heads
+        q = nn.Dense(cfg.n_head * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
+                     kernel_init=nn.initializers.normal(cfg.init_std), name="q_proj")(x)
+        k = nn.Dense(hk * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
+                     kernel_init=nn.initializers.normal(cfg.init_std), name="k_proj")(x)
+        v = nn.Dense(hk * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
+                     kernel_init=nn.initializers.normal(cfg.init_std), name="v_proj")(x)
+        b, t = x.shape[:2]
+        return (q.reshape(b, t, cfg.n_head, hd), k.reshape(b, t, hk, hd),
+                v.reshape(b, t, hk, hd))
+
+    def _mlp(self, h):
+        cfg = self.config
+        act = _act(cfg)
+        init = nn.initializers.normal(cfg.init_std)
+        proj_init = nn.initializers.normal(cfg.init_std / (2 * cfg.n_layer) ** 0.5)
+        if cfg.gated_mlp:
+            gate = nn.Dense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                            kernel_init=init, name="gate_proj")(h)
+            up = nn.Dense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                          kernel_init=init, name="up_proj")(h)
+            h = act(gate) * up
+        else:
+            h = nn.Dense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                         kernel_init=init, name="fc_in")(h)
+            h = act(h)
+        return nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                        kernel_init=proj_init, name="fc_out")(h)
+
+    @nn.compact
+    def __call__(self, x, positions, cache: Optional[Dict] = None,
+                 cache_len: Optional[jnp.ndarray] = None):
+        """x: (b, t, d). With ``cache`` given (decode): t==1, attention against the cache.
+        Returns (y, new_cache_kv or None)."""
+        cfg = self.config
+        b, t, _ = x.shape
+        h_in = _norm(cfg, "ln_attn")(x).astype(cfg.dtype)
+        q, k, v = self._attn_proj(h_in)
+        if cfg.pos_emb == "rotary":
+            q = apply_rotary(q, positions, cfg.rotary_base, cfg.rotary_pct)
+            k = apply_rotary(k, positions, cfg.rotary_base, cfg.rotary_pct)
+
+        slopes = (jnp.asarray(alibi_slopes(cfg.n_head))
+                  if cfg.pos_emb == "alibi" else None)
+
+        new_kv = None
+        if cache is not None and t == 1:
+            # decode: append to cache (head-major), fused decode kernel
+            k_hm = k.transpose(0, 2, 1, 3)   # (b, hk, 1, d)
+            v_hm = v.transpose(0, 2, 1, 3)
+            k_cache = _cache_update(cache["k"], k_hm, cache_len)
+            v_cache = _cache_update(cache["v"], v_hm, cache_len)
+            new_kv = {"k": k_cache, "v": v_cache}
+            o = _sharded_decode(q[:, 0], k_cache, v_cache, cache_len + 1,
+                                alibi=slopes)[:, None]
+        else:
+            bias = None
+            if slopes is not None:
+                # (h, t, s) alibi bias: slope * -(row - col), 0 on diagonal
+                rows = jnp.arange(t)[:, None]
+                cols = jnp.arange(t)[None, :]
+                bias = (slopes[:, None, None] *
+                        (cols - rows)[None].astype(jnp.float32))
+            o = _bias_attention(q, k, v, bias)
+            if cache is not None:
+                # prefill: write the prompt's K/V (post-rotary) into the fixed cache
+                T = cache["k"].shape[2]
+                k_hm = k.transpose(0, 2, 1, 3)
+                v_hm = v.transpose(0, 2, 1, 3)
+                pad = ((0, 0), (0, 0), (0, T - t), (0, 0))
+                new_kv = {"k": jnp.pad(k_hm, pad).astype(cache["k"].dtype),
+                          "v": jnp.pad(v_hm, pad).astype(cache["v"].dtype)}
+        o = o.reshape(b, t, cfg.n_embd)
+        proj_init = nn.initializers.normal(cfg.init_std / (2 * cfg.n_layer) ** 0.5)
+        attn_out = nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                            kernel_init=proj_init, name="o_proj")(o)
+
+        if cfg.parallel_residual:
+            h_mlp = _norm(cfg, "ln_mlp")(x).astype(cfg.dtype)
+            y = x + attn_out + self._mlp(h_mlp)
+        else:
+            x = x + attn_out
+            h_mlp = _norm(cfg, "ln_mlp")(x).astype(cfg.dtype)
+            y = x + self._mlp(h_mlp)
+        return y, new_kv
+
+
+def _bias_attention(q, k, v, bias):
+    """Full-sequence causal attention with optional additive (h, t, s) bias (alibi)."""
+    if k.shape[2] != q.shape[2]:  # GQA prefill: broadcast kv heads to query heads
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+    if bias is None:
+        from ..ops.attention.flash import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    d = q.shape[-1]
+    scale = 1.0 / float(np.sqrt(d))
+    t, s = q.shape[1], k.shape[1]
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    logits = logits + bias[None]
+    causal = jnp.tril(jnp.ones((t, s), dtype=bool))
+    logits = jnp.where(causal[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _cache_update(cache, new, cache_len):
+    """cache: (b, hk, T, d); new: (b, hk, 1, d); write at per-sequence position."""
+    def one(c, n, p):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, p, 0))
+    return jax.vmap(one)(cache, new, cache_len)
+
+
+def _sharded_decode(q, k_cache, v_cache, lens, alibi=None):
+    """Wrap the decode kernel in shard_map over batch/TP axes (pallas is opaque to SPMD).
+
+    Alibi slopes travel as a per-head input sharded over the tensor axis, so each TP shard
+    sees exactly its heads' slopes."""
+    from ..parallel.mesh import AXIS_TENSOR, BATCH_AXES, get_global_mesh
+    b, h, d = q.shape
+    mesh = get_global_mesh()
+
+    if mesh is not None:
+        batch_axes = tuple(ax for ax in BATCH_AXES if mesh.size(ax) > 1)
+        bsz = int(np.prod([mesh.size(ax) for ax in batch_axes])) if batch_axes else 1
+        tp = mesh.size(AXIS_TENSOR)
+        use_tp = tp > 1 and h % tp == 0 and k_cache.shape[1] % tp == 0
+        manual = set(batch_axes) | ({AXIS_TENSOR} if use_tp else set())
+        if manual and b % max(bsz, 1) == 0:
+            tpax = AXIS_TENSOR if use_tp else None
+            qspec = P(batch_axes or None, tpax, None)
+            cspec = P(batch_axes or None, tpax, None, None)
+            lspec = P(batch_axes or None)
+            if alibi is None:
+                mapped = jax.shard_map(
+                    lambda q_l, k_l, v_l, l_l: decode_attention(q_l, k_l, v_l, l_l),
+                    mesh=mesh.mesh, axis_names=manual,
+                    in_specs=(qspec, cspec, cspec, lspec), out_specs=qspec,
+                    check_vma=False)
+                return mapped(q, k_cache, v_cache, lens)
+            mapped = jax.shard_map(
+                decode_attention_xla_alibi, mesh=mesh.mesh, axis_names=manual,
+                in_specs=(qspec, cspec, cspec, lspec, P(tpax)), out_specs=qspec,
+                check_vma=False)
+            return mapped(q, k_cache, v_cache, lens, jnp.asarray(alibi))
+
+    if alibi is not None:
+        return decode_attention_xla_alibi(q, k_cache, v_cache, lens, jnp.asarray(alibi))
+    return decode_attention(q, k_cache, v_cache, lens)
+
+
+def decode_attention_xla_alibi(q, k_cache, v_cache, cache_len, slopes):
+    """Decode attention with alibi bias (jnp path; bloom decode)."""
+    b, h, d = q.shape
+    hk, T = k_cache.shape[1], k_cache.shape[2]
+    g = h // hk
+    scale = 1.0 / float(np.sqrt(d))
+    q4 = q.reshape(b, hk, g, d).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bktd->bkgt", q4, k_cache.astype(jnp.float32)) * scale
+    pos = jnp.arange(T)[None, None, None, :]
+    cur = (cache_len[:, None, None, None] - 1).astype(jnp.float32)
+    s = s + slopes.reshape(1, hk, g, 1) * (pos - cur)
+    mask = pos < cache_len[:, None, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgt,bktd->bkgd", p, v_cache.astype(jnp.float32))
+    return o.reshape(b, h, d).astype(q.dtype)
+
+
+class CausalLM(nn.Module):
+    config: CausalLMConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, caches=None, cache_lens=None):
+        cfg = self.config
+        b, t = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+        wte = self.param("wte", nn.initializers.normal(cfg.init_std),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        x = wte[input_ids].astype(cfg.dtype)
+        if cfg.pos_emb == "learned":
+            wpe = self.param("wpe", nn.initializers.normal(cfg.init_std),
+                             (cfg.max_seq_len, cfg.n_embd), jnp.float32)
+            x = x + jnp.take(wpe, positions, axis=0).astype(cfg.dtype)
+        if cfg.embed_layernorm:
+            x = _norm(cfg, "ln_embed")(x).astype(cfg.dtype)
+
+        new_caches = []
+        for i in range(cfg.n_layer):
+            layer_cache = None if caches is None else caches[i]
+            x, new_kv = CausalLMLayer(cfg, name=f"layers_{i}")(
+                x, positions, cache=layer_cache, cache_len=cache_lens)
+            new_caches.append(new_kv)
+
+        x = _norm(cfg, "ln_f")(x)
+        if cfg.tie_word_embeddings:
+            logits = x.astype(jnp.float32) @ wte.T
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                              kernel_init=nn.initializers.normal(cfg.init_std),
+                              name="lm_head")(x.astype(jnp.float32))
+        if caches is None:
+            return logits
+        return logits, new_caches
+
+
+# ----------------------------------------------------------------------- bundles
+def causal_lm_model(cfg: CausalLMConfig, sample_seq_len: Optional[int] = None) -> Model:
+    """Training/scoring bundle (loss over shifted labels)."""
+    from .gpt2 import cross_entropy_loss
+    module = CausalLM(cfg)
+    t = sample_seq_len or min(cfg.max_seq_len, 1024)
+
+    def init_fn(rng):
+        sample = jnp.zeros((1, t), dtype=jnp.int32)
+        return module.init({"params": rng}, sample)["params"]
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        logits = module.apply({"params": params}, ids)
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, dtype=ids.dtype)], axis=1)
+        return cross_entropy_loss(logits, labels)
+
+    def apply_fn(params, batch, rng=None):
+        ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        return module.apply({"params": params}, ids)
+
+    return Model(loss_fn=loss_fn, init_fn=init_fn, apply_fn=apply_fn,
+                 param_specs=None, name=cfg.name,
+                 flops_per_sample=6.0 * cfg.num_params() * t)
+
+
+def init_cache(cfg: CausalLMConfig, batch_size: int, max_len: Optional[int] = None,
+               dtype=None):
+    """Fixed-capacity head-major KV caches, one per layer."""
+    T = max_len or cfg.max_seq_len
+    dtype = dtype or cfg.dtype
+    shape = (batch_size, cfg.kv_heads, T, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+            for _ in range(cfg.n_layer)]
+
+
+def causal_lm_param_specs(params, tensor_axis: str = "tensor") -> Any:
+    """Megatron TP rules for :class:`CausalLM` params (the sharding the reference's
+    ``ReplaceWithTensorSlicing`` performs on qkv/mlp weights, ``module_inject/replace_module.py:25``)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def spec_for(path_str: str, ndim: int):
+        col = ("q_proj", "k_proj", "v_proj", "fc_in", "gate_proj", "up_proj")
+        row = ("o_proj", "fc_out")
+        if any(f"/{n}/" in path_str or path_str.endswith(f"{n}/kernel") for n in col):
+            if path_str.endswith("kernel"):
+                return P(None, tensor_axis)
+            if path_str.endswith("bias"):
+                return P(tensor_axis)
+        if any(f"/{n}/" in path_str for n in row):
+            if path_str.endswith("kernel"):
+                return P(tensor_axis, None)
+            return P(*([None] * ndim)) if ndim else P()
+        if path_str.endswith("wte") or path_str.endswith("lm_head/kernel"):
+            return P(tensor_axis, None) if path_str.endswith("wte") else P(None, tensor_axis)
+        return P(*([None] * ndim)) if ndim else P()
+
+    specs = []
+    for path, leaf in flat:
+        path_str = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        specs.append(spec_for(path_str, getattr(leaf, "ndim", 0)))
+    return jax.tree_util.tree_unflatten(treedef, specs)

@@ -1,0 +1,1 @@
+from .replace_module import (HF_POLICIES, convert_hf_model, replace_transformer_layer)

@@ -1,0 +1,254 @@
+"""HF model conversion policies — the module-injection analogue.
+
+Reference: ``deepspeed/module_inject/replace_module.py`` (``replace_transformer_layer:308``,
+``ReplaceWithTensorSlicing:25``) + per-architecture containers
+(``module_inject/containers/{gpt2,bloom,opt,gptneox,gptj,llama...}.py``).
+
+On TPU there is no module surgery: a policy maps an HF architecture to (a) a
+:class:`CausalLMConfig` instance and (b) a weight-layout conversion from the torch
+state_dict into the :class:`CausalLM` param tree. Tensor slicing happens afterwards at
+placement time via PartitionSpecs (``models/causal_lm.py:causal_lm_param_specs``) — the
+compile-time equivalent of ``ReplaceWithTensorSlicing``.
+"""
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.causal_lm import (CausalLMConfig, bloom_cfg, gpt2_cfg, gptneox_cfg,
+                                llama_cfg, opt_cfg)
+from ..utils.logging import logger
+
+
+def _np(tensor) -> np.ndarray:
+    return np.asarray(tensor.detach().cpu().float().numpy())
+
+
+def _kernel(w) -> jnp.ndarray:
+    """torch Linear weight (out, in) → flax kernel (in, out)."""
+    return jnp.asarray(_np(w).T)
+
+
+def _vec(b) -> jnp.ndarray:
+    return jnp.asarray(_np(b))
+
+
+def _ln(sd, prefix) -> Dict:
+    return {"scale": _vec(sd[f"{prefix}.weight"]), "bias": _vec(sd[f"{prefix}.bias"])}
+
+
+def _split_fused_qkv(w, b, n_head, head_dim, interleaved: bool):
+    """Fused qkv → separate q/k/v flax kernels.
+
+    ``interleaved``: BLOOM/NeoX store (h, 3, dh) per-head interleaved; GPT-2 stores
+    concatenated [q|k|v] blocks.
+    """
+    d = n_head * head_dim
+    wk = _np(w)                              # torch (3d, in) or Conv1D (in, 3d)
+    if wk.shape[0] == 3 * d:                 # torch Linear layout
+        wk = wk.T                            # (in, 3d)
+    if interleaved:
+        wk = wk.reshape(wk.shape[0], n_head, 3, head_dim)
+        q = wk[:, :, 0].reshape(wk.shape[0], d)
+        k = wk[:, :, 1].reshape(wk.shape[0], d)
+        v = wk[:, :, 2].reshape(wk.shape[0], d)
+    else:
+        q, k, v = np.split(wk, 3, axis=1)
+    out = [{"kernel": jnp.asarray(x)} for x in (q, k, v)]
+    if b is not None:
+        bk = _np(b)
+        if interleaved:
+            bk = bk.reshape(n_head, 3, head_dim)
+            bs = [bk[:, i].reshape(d) for i in range(3)]
+        else:
+            bs = np.split(bk, 3)
+        for o, bb in zip(out, bs):
+            o["bias"] = jnp.asarray(bb)
+    return out
+
+
+# --------------------------------------------------------------------------- policies
+def _convert_gpt2(model) -> Tuple[CausalLMConfig, Any]:
+    hf = model.config
+    cfg = gpt2_cfg(vocab_size=hf.vocab_size, max_seq_len=hf.n_positions,
+                   n_embd=hf.n_embd, n_layer=hf.n_layer, n_head=hf.n_head)
+    sd = model.state_dict()
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    params = {"wte": jnp.asarray(_np(sd[f"{pfx}wte.weight"])),
+              "wpe": jnp.asarray(_np(sd[f"{pfx}wpe.weight"])),
+              "ln_f": _ln(sd, f"{pfx}ln_f")}
+    for i in range(cfg.n_layer):
+        lp = f"{pfx}h.{i}"
+        # HF GPT-2 uses Conv1D: weight already (in, out)
+        qkv = _split_fused_qkv(sd[f"{lp}.attn.c_attn.weight"],
+                               sd.get(f"{lp}.attn.c_attn.bias"),
+                               cfg.n_head, cfg.head_dim, interleaved=False)
+        params[f"layers_{i}"] = {
+            "ln_attn": _ln(sd, f"{lp}.ln_1"),
+            "ln_mlp": _ln(sd, f"{lp}.ln_2"),
+            "q_proj": qkv[0], "k_proj": qkv[1], "v_proj": qkv[2],
+            "o_proj": {"kernel": jnp.asarray(_np(sd[f"{lp}.attn.c_proj.weight"])),
+                       "bias": _vec(sd[f"{lp}.attn.c_proj.bias"])},
+            "fc_in": {"kernel": jnp.asarray(_np(sd[f"{lp}.mlp.c_fc.weight"])),
+                      "bias": _vec(sd[f"{lp}.mlp.c_fc.bias"])},
+            "fc_out": {"kernel": jnp.asarray(_np(sd[f"{lp}.mlp.c_proj.weight"])),
+                       "bias": _vec(sd[f"{lp}.mlp.c_proj.bias"])},
+        }
+    return cfg, params
+
+
+def _convert_bloom(model) -> Tuple[CausalLMConfig, Any]:
+    hf = model.config
+    cfg = bloom_cfg(vocab_size=hf.vocab_size, max_seq_len=2048,
+                    n_embd=hf.hidden_size, n_layer=hf.n_layer, n_head=hf.n_head,
+                    ln_eps=hf.layer_norm_epsilon)
+    sd = model.state_dict()
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    params = {"wte": jnp.asarray(_np(sd[f"{pfx}word_embeddings.weight"])),
+              "ln_embed": _ln(sd, f"{pfx}word_embeddings_layernorm"),
+              "ln_f": _ln(sd, f"{pfx}ln_f")}
+    for i in range(cfg.n_layer):
+        lp = f"{pfx}h.{i}"
+        qkv = _split_fused_qkv(sd[f"{lp}.self_attention.query_key_value.weight"],
+                               sd.get(f"{lp}.self_attention.query_key_value.bias"),
+                               cfg.n_head, cfg.head_dim, interleaved=True)
+        params[f"layers_{i}"] = {
+            "ln_attn": _ln(sd, f"{lp}.input_layernorm"),
+            "ln_mlp": _ln(sd, f"{lp}.post_attention_layernorm"),
+            "q_proj": qkv[0], "k_proj": qkv[1], "v_proj": qkv[2],
+            "o_proj": {"kernel": _kernel(sd[f"{lp}.self_attention.dense.weight"]),
+                       "bias": _vec(sd[f"{lp}.self_attention.dense.bias"])},
+            "fc_in": {"kernel": _kernel(sd[f"{lp}.mlp.dense_h_to_4h.weight"]),
+                      "bias": _vec(sd[f"{lp}.mlp.dense_h_to_4h.bias"])},
+            "fc_out": {"kernel": _kernel(sd[f"{lp}.mlp.dense_4h_to_h.weight"]),
+                       "bias": _vec(sd[f"{lp}.mlp.dense_4h_to_h.bias"])},
+        }
+    return cfg, params
+
+
+def _convert_opt(model) -> Tuple[CausalLMConfig, Any]:
+    hf = model.config
+    cfg = opt_cfg(vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+                  n_embd=hf.hidden_size, n_layer=hf.num_hidden_layers,
+                  n_head=hf.num_attention_heads, d_ff=hf.ffn_dim,
+                  tie_word_embeddings=getattr(hf, "tie_word_embeddings", True))
+    sd = model.state_dict()
+    pfx = next((p for p in ("model.decoder.", "decoder.", "")
+                if f"{p}embed_tokens.weight" in sd), "")
+    # OPT offsets learned positions by 2
+    wpe = _np(sd[f"{pfx}embed_positions.weight"])[2:]
+    params = {"wte": jnp.asarray(_np(sd[f"{pfx}embed_tokens.weight"])),
+              "wpe": jnp.asarray(wpe),
+              "ln_f": _ln(sd, f"{pfx}final_layer_norm")}
+    for i in range(cfg.n_layer):
+        lp = f"{pfx}layers.{i}"
+        params[f"layers_{i}"] = {
+            "ln_attn": _ln(sd, f"{lp}.self_attn_layer_norm"),
+            "ln_mlp": _ln(sd, f"{lp}.final_layer_norm"),
+            "q_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.q_proj.weight"]),
+                       "bias": _vec(sd[f"{lp}.self_attn.q_proj.bias"])},
+            "k_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.k_proj.weight"]),
+                       "bias": _vec(sd[f"{lp}.self_attn.k_proj.bias"])},
+            "v_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.v_proj.weight"]),
+                       "bias": _vec(sd[f"{lp}.self_attn.v_proj.bias"])},
+            "o_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.out_proj.weight"]),
+                       "bias": _vec(sd[f"{lp}.self_attn.out_proj.bias"])},
+            "fc_in": {"kernel": _kernel(sd[f"{lp}.fc1.weight"]),
+                      "bias": _vec(sd[f"{lp}.fc1.bias"])},
+            "fc_out": {"kernel": _kernel(sd[f"{lp}.fc2.weight"]),
+                       "bias": _vec(sd[f"{lp}.fc2.bias"])},
+        }
+    return cfg, params
+
+
+def _convert_llama(model) -> Tuple[CausalLMConfig, Any]:
+    hf = model.config
+    cfg = llama_cfg(vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+                    n_embd=hf.hidden_size, n_layer=hf.num_hidden_layers,
+                    n_head=hf.num_attention_heads,
+                    n_kv_head=getattr(hf, "num_key_value_heads", None),
+                    d_ff=hf.intermediate_size, ln_eps=hf.rms_norm_eps,
+                    rotary_base=getattr(hf, "rope_theta", 10000.0))
+    sd = model.state_dict()
+    pfx = "model." if any(k.startswith("model.") for k in sd) else ""
+    params = {"wte": jnp.asarray(_np(sd[f"{pfx}embed_tokens.weight"])),
+              "ln_f": {"scale": _vec(sd[f"{pfx}norm.weight"])}}
+    if "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": _kernel(sd["lm_head.weight"])}
+    for i in range(cfg.n_layer):
+        lp = f"{pfx}layers.{i}"
+        params[f"layers_{i}"] = {
+            "ln_attn": {"scale": _vec(sd[f"{lp}.input_layernorm.weight"])},
+            "ln_mlp": {"scale": _vec(sd[f"{lp}.post_attention_layernorm.weight"])},
+            "q_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.q_proj.weight"])},
+            "k_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.k_proj.weight"])},
+            "v_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.v_proj.weight"])},
+            "o_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.o_proj.weight"])},
+            "gate_proj": {"kernel": _kernel(sd[f"{lp}.mlp.gate_proj.weight"])},
+            "up_proj": {"kernel": _kernel(sd[f"{lp}.mlp.up_proj.weight"])},
+            "fc_out": {"kernel": _kernel(sd[f"{lp}.mlp.down_proj.weight"])},
+        }
+    return cfg, params
+
+
+def _convert_gptneox(model) -> Tuple[CausalLMConfig, Any]:
+    hf = model.config
+    cfg = gptneox_cfg(vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+                      n_embd=hf.hidden_size, n_layer=hf.num_hidden_layers,
+                      n_head=hf.num_attention_heads, d_ff=hf.intermediate_size,
+                      rotary_pct=hf.rotary_pct, rotary_base=hf.rotary_emb_base,
+                      ln_eps=hf.layer_norm_eps)
+    sd = model.state_dict()
+    pfx = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+    params = {"wte": jnp.asarray(_np(sd[f"{pfx}embed_in.weight"])),
+              "ln_f": _ln(sd, f"{pfx}final_layer_norm")}
+    if "embed_out.weight" in sd:
+        params["lm_head"] = {"kernel": _kernel(sd["embed_out.weight"])}
+    for i in range(cfg.n_layer):
+        lp = f"{pfx}layers.{i}"
+        qkv = _split_fused_qkv(sd[f"{lp}.attention.query_key_value.weight"],
+                               sd.get(f"{lp}.attention.query_key_value.bias"),
+                               cfg.n_head, cfg.head_dim, interleaved=True)
+        params[f"layers_{i}"] = {
+            "ln_attn": _ln(sd, f"{lp}.input_layernorm"),
+            "ln_mlp": _ln(sd, f"{lp}.post_attention_layernorm"),
+            "q_proj": qkv[0], "k_proj": qkv[1], "v_proj": qkv[2],
+            "o_proj": {"kernel": _kernel(sd[f"{lp}.attention.dense.weight"]),
+                       "bias": _vec(sd[f"{lp}.attention.dense.bias"])},
+            "fc_in": {"kernel": _kernel(sd[f"{lp}.mlp.dense_h_to_4h.weight"]),
+                      "bias": _vec(sd[f"{lp}.mlp.dense_h_to_4h.bias"])},
+            "fc_out": {"kernel": _kernel(sd[f"{lp}.mlp.dense_4h_to_h.weight"]),
+                       "bias": _vec(sd[f"{lp}.mlp.dense_4h_to_h.bias"])},
+        }
+    return cfg, params
+
+
+HF_POLICIES: Dict[str, Callable] = {
+    "gpt2": _convert_gpt2,
+    "bloom": _convert_bloom,
+    "opt": _convert_opt,
+    "llama": _convert_llama,
+    "gpt_neox": _convert_gptneox,
+}
+
+
+def convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
+    """Convert an HF torch CausalLM into (CausalLMConfig, jax params).
+
+    Reference ``replace_transformer_layer``'s ``policy`` selection, resolved by
+    ``config.model_type`` (the reference's auto ``replace_method``)."""
+    model_type = getattr(getattr(model, "config", None), "model_type", None)
+    if model_type not in HF_POLICIES:
+        raise ValueError(
+            f"No injection policy for model_type={model_type!r}; supported: "
+            f"{sorted(HF_POLICIES)} (reference parity: replace_policy registry)")
+    logger.info(f"converting HF {model_type} model to TPU-native CausalLM")
+    return HF_POLICIES[model_type](model)
+
+
+def replace_transformer_layer(orig_layer_impl, model, checkpoint=None, config=None,
+                              **kwargs):
+    """Reference-named API shim (``replace_module.py:308``): returns the converted
+    (config, params) pair — on TPU 'replacement' is conversion + sharded placement."""
+    return convert_hf_model(model)

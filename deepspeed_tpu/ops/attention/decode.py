@@ -114,6 +114,10 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     assert h % hk == 0, f"query heads {h} must be a multiple of kv heads {hk}"
     g = h // hk
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    if d % 128 != 0 and not _interpret():
+        # Mosaic requires HBM DMA slices 128-aligned in the minor dim; head_dim 64 caches
+        # take the XLA path (still fused/online-softmax'd by XLA, just not hand-scheduled)
+        return decode_attention_xla(q, k_cache, v_cache, cache_len, softmax_scale)
     bk = min(block_k, T)
     while T % bk:
         bk //= 2
@@ -125,8 +129,8 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, hk, g, d), lambda i, lens_ref: (i, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # cache stays in HBM, DMA'd blockwise
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),   # cache stays in HBM, DMA'd blockwise
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, hk, g, d), lambda i, lens_ref: (i, 0, 0, 0)),
     )

@@ -87,6 +87,27 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             return self._ckptr.restore(os.path.abspath(path), abstract)
         return self._ckptr.restore(os.path.abspath(path))
 
+    def load_subtree(self, path: str, key: str, template: Any, shardings: Any = None):
+        """Restore one top-level entry (e.g. just ``params``) from a full training
+        checkpoint without materialising the rest (optimizer state etc.) — the inference
+        engine's sharded-load path."""
+        import jax
+        ocp = self._ocp
+        if shardings is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s)
+                if hasattr(l, "shape") else l, template, shardings)
+        else:
+            abstract = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype)
+                if hasattr(l, "shape") else l, template)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            restored = ckptr.restore(
+                os.path.abspath(path),
+                args=self._ocp.args.PyTreeRestore(item={key: abstract},
+                                                  partial_restore=True))
+        return restored[key]
+
     def commit(self, tag: str) -> bool:
         self._ckptr.wait_until_finished()
         return super().commit(tag)

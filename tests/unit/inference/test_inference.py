@@ -1,0 +1,167 @@
+"""Inference engine tests — analogue of reference ``tests/unit/inference/test_inference.py``
+(parametrized HF-model injection) + KV-cache correctness checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.models.causal_lm import (CausalLM, bloom_cfg, gpt2_cfg, gptneox_cfg,
+                                            llama_cfg, opt_cfg)
+from deepspeed_tpu.parallel.mesh import MeshSpec
+
+TINY = dict(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+            dtype=jnp.float32)
+
+
+def _greedy_nocache(cfg, params, ids, steps):
+    """Ground truth: full forward + argmax each step (no cache)."""
+    module = CausalLM(cfg)
+    cur = np.asarray(ids)
+    for _ in range(steps):
+        logits = module.apply({"params": params}, jnp.asarray(cur))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))[:, None]
+        cur = np.concatenate([cur, nxt], axis=1)
+    return cur
+
+
+@pytest.mark.parametrize("family", [gpt2_cfg, bloom_cfg, opt_cfg, llama_cfg, gptneox_cfg])
+def test_cached_generate_matches_nocache(family):
+    """The fused KV-cache decode path must reproduce the uncached greedy rollout —
+    covers learned/alibi/rotary positions, parallel residual, RMSNorm, gated MLP."""
+    cfg = family(**TINY)
+    engine = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    out = engine.generate(ids, max_new_tokens=6)
+    ref = _greedy_nocache(cfg, engine.params, ids, 6)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_gqa_cached_generate():
+    cfg = llama_cfg(**{**TINY, "n_kv_head": 2})
+    engine = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    out = engine.generate(ids, max_new_tokens=4)
+    ref = _greedy_nocache(cfg, engine.params, ids, 4)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_tp_generate_matches_single(eight_devices):
+    """TP-sharded serving computes the same tokens as unsharded (reference auto-TP
+    correctness)."""
+    cfg = gpt2_cfg(**TINY)
+    e1 = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64),
+        mesh_spec=MeshSpec({"tensor": 1}, eight_devices[:1]))
+    params = e1.params
+    e2 = InferenceEngine((cfg, jax.tree_util.tree_map(np.asarray, params)),
+                         ds.inference.DeepSpeedInferenceConfig(
+                             dtype="float32", max_out_tokens=64,
+                             tensor_parallel={"tp_size": 4}),
+                         mesh_spec=MeshSpec({"tensor": 4}, eight_devices[:4]))
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    out1 = e1.generate(ids, max_new_tokens=5)
+    out2 = e2.generate(ids, max_new_tokens=5)
+    np.testing.assert_array_equal(out1, out2)
+    # params physically sharded over tensor axis
+    qk = e2.params["layers_0"]["q_proj"]["kernel"]
+    assert "tensor" in str(qk.sharding.spec)
+
+
+def test_sampling_controls():
+    cfg = gpt2_cfg(**TINY)
+    engine = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, cfg.vocab_size, size=(1, 4)).astype(np.int32)
+    a = engine.generate(ids, max_new_tokens=5, do_sample=True, temperature=0.8, seed=0)
+    b = engine.generate(ids, max_new_tokens=5, do_sample=True, temperature=0.8, seed=0)
+    c = engine.generate(ids, max_new_tokens=5, do_sample=True, temperature=0.8, seed=1)
+    np.testing.assert_array_equal(a, b)        # deterministic per seed
+    assert a.shape == (1, 9)
+    assert not np.array_equal(a, c) or True    # different seed may differ
+    with pytest.raises(NotImplementedError):
+        engine.generate(ids, max_new_tokens=2, num_beams=4)
+
+
+def test_init_inference_api():
+    """deepspeed.init_inference parity: dict config with mp_size/dtype knobs."""
+    cfg = gpt2_cfg(**TINY)
+    engine = ds.init_inference(cfg, config={"dtype": "float32", "max_out_tokens": 64})
+    ids = np.zeros((1, 4), dtype=np.int32)
+    logits = engine(ids)
+    assert logits.shape == (1, 4, cfg.vocab_size)
+
+
+# --------------------------------------------------------------- HF conversion policies
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _logits_close(hf_model, ids, atol=2e-3):
+    from deepspeed_tpu.module_inject import convert_hf_model
+    from deepspeed_tpu.parallel.mesh import set_global_mesh
+    set_global_mesh(None)  # earlier tests may leave a multi-device mesh active
+    cfg, params = convert_hf_model(hf_model)
+    cfg.dtype = jnp.float32
+    ours = CausalLM(cfg).apply({"params": params}, jnp.asarray(ids))
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=atol, rtol=1e-3)
+
+
+def test_hf_gpt2_conversion():
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+    hf.eval()
+    ids = np.random.default_rng(4).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)
+
+
+def test_hf_bloom_conversion():
+    hf = transformers.BloomForCausalLM(transformers.BloomConfig(
+        vocab_size=96, hidden_size=32, n_layer=2, n_head=4,
+        hidden_dropout=0.0, attention_dropout=0.0))
+    hf.eval()
+    ids = np.random.default_rng(5).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)
+
+
+def test_hf_opt_conversion():
+    hf = transformers.OPTForCausalLM(transformers.OPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        ffn_dim=64, max_position_embeddings=64, dropout=0.0, word_embed_proj_dim=32))
+    hf.eval()
+    ids = np.random.default_rng(6).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)
+
+
+def test_hf_llama_conversion():
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=64, max_position_embeddings=64))
+    hf.eval()
+    ids = np.random.default_rng(7).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)
+
+
+def test_hf_generate_through_engine():
+    """End-to-end reference flow: HF torch model → init_inference → generate."""
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+    hf.eval()
+    engine = ds.init_inference(hf, config={"dtype": "float32", "max_out_tokens": 64})
+    ids = np.random.default_rng(8).integers(0, 96, size=(1, 6)).astype(np.int32)
+    out = engine.generate(ids, max_new_tokens=5)
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(ids), max_new_tokens=5, do_sample=False)
+    np.testing.assert_array_equal(out, hf_out.numpy())
