@@ -1,19 +1,49 @@
 """Benchmark entry point — prints ONE JSON line.
 
 Runs the BASELINE config-1 workload shape on whatever chip is attached: GPT-2 125M causal-LM
-training, ZeRO stage 1, bf16, fused train step. Metric: training throughput in tokens/sec/chip.
-``vs_baseline`` is 1.0-relative once a reference number exists; ``BASELINE.json`` ``published``
-is empty for TPU configs, so we report the ratio against the first recorded value of this same
-bench (stored in ``.bench_baseline.json`` on first successful run).
+training, ZeRO stage 1, bf16, fused train step (flash-attention Pallas kernel on TPU).
+Metric: training throughput in tokens/sec/chip, plus honest ``tflops_per_chip`` (model FLOPs,
+not recompute) and ``mfu`` against the chip's peak bf16 rate. ``vs_baseline`` is the ratio
+against the first recorded value of this bench (``.bench_baseline.json``).
+
+``--mode inference`` benches the serving path: p50 TTFT (prefill) + decode tokens/sec on the
+flagship model — the second BASELINE north-star (config 5 shape, scaled to one chip).
 """
 
+import argparse
 import json
 import os
 import sys
 import time
 
+# Peak dense bf16 TFLOP/s per chip by device_kind (public spec sheets).
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
 
-def main():
+
+def _sync(x):
+    """Device sync by host-fetching a (scalar) result — jax.effects_barrier does not reliably
+    block on tunneled platforms."""
+    import numpy as np
+    return np.asarray(x)
+
+
+def peak_tflops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_TFLOPS.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
+def bench_train():
     import numpy as np
 
     import deepspeed_tpu as ds
@@ -22,7 +52,7 @@ def main():
     import jax
 
     seq = int(os.environ.get("BENCH_SEQ", 1024))
-    micro = int(os.environ.get("BENCH_MICRO", 8))
+    micro = int(os.environ.get("BENCH_MICRO", 32))
     steps = int(os.environ.get("BENCH_STEPS", 20))
     warmup = 3
 
@@ -46,15 +76,19 @@ def main():
     batch = {"input_ids": rng.integers(0, 50304, size=(micro * n_chips, seq),
                                        dtype=np.int32)}
     for _ in range(warmup):
-        engine.train_batch(batch)
-    jax.effects_barrier()
+        loss = engine.train_batch(batch)
+    _sync(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        engine.train_batch(batch)
-    jax.effects_barrier()
+        loss = engine.train_batch(batch)
+    _sync(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_sec_per_chip = micro * n_chips * seq * steps / dt / n_chips
+    flops_per_token = cfg.flops_per_token()          # 6N + attention (model FLOPs, no remat)
+    tflops_per_chip = tokens_per_sec_per_chip * flops_per_token / 1e12
+    peak = peak_tflops()
+
     baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".bench_baseline.json")
     vs_baseline = 1.0
@@ -68,12 +102,75 @@ def main():
     except Exception:
         pass
 
-    print(json.dumps({
+    out = {
         "metric": "gpt2_125m_zero1_bf16_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs_baseline, 4),
-    }))
+        "tflops_per_chip": round(tflops_per_chip, 2),
+        "micro_batch": micro,
+        "seq": seq,
+    }
+    if peak:
+        out["mfu"] = round(tflops_per_chip / peak, 4)
+    print(json.dumps(out))
+
+
+def bench_inference():
+    import numpy as np
+
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import gpt2_cfg, causal_lm_model
+
+    prompt_len = int(os.environ.get("BENCH_PROMPT", 512))
+    gen_len = int(os.environ.get("BENCH_GEN", 128))
+    batch = int(os.environ.get("BENCH_INFER_BATCH", 1))
+    iters = int(os.environ.get("BENCH_INFER_ITERS", 5))
+
+    cfg = gpt2_cfg(vocab_size=50304, max_seq_len=prompt_len + gen_len,
+                   n_embd=768, n_layer=12, n_head=12)
+    model = causal_lm_model(cfg)
+    engine = ds.init_inference(model=model, config={"dtype": "bfloat16",
+                                                    "max_out_tokens": prompt_len + gen_len})
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 50304, size=(batch, prompt_len), dtype=np.int32)
+
+    # warmup (compiles prefill + decode)
+    out = engine.generate(ids, max_new_tokens=8)
+    _sync(out)
+
+    ttfts, decode_tps = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = engine.generate(ids, max_new_tokens=gen_len)
+        _sync(out)
+        dt = time.perf_counter() - t0
+        ttfts.append(engine.ttft)                     # prefill-to-first-token, set by generate
+        decode_tps.append(batch * (gen_len - 1) / max(dt - engine.ttft, 1e-9))
+
+    ttft_p50 = sorted(ttfts)[len(ttfts) // 2] * 1e3 if ttfts else None
+    tps = sorted(decode_tps)[len(decode_tps) // 2]
+    out = {
+        "metric": "gpt2_125m_bf16_decode_tokens_per_sec",
+        "value": round(tps, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }
+    if ttft_p50 is not None:
+        out["ttft_p50_ms"] = round(ttft_p50, 2)
+    print(json.dumps(out))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["train", "inference"], default="train")
+    args = p.parse_args()
+    if args.mode == "train":
+        bench_train()
+    else:
+        bench_inference()
 
 
 if __name__ == "__main__":

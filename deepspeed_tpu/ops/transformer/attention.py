@@ -46,7 +46,14 @@ def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def get_attention_impl(name: str = "xla"):
-    """Resolve an attention implementation by name: ``xla`` | ``flash`` | ``ring``."""
+    """Resolve an attention implementation by name: ``auto`` | ``xla`` | ``flash`` | ``ring``.
+
+    ``auto`` picks the Pallas flash kernel on a real TPU backend and XLA attention elsewhere
+    (on CPU the Pallas kernel runs in interpreter mode, which is orders of magnitude slower —
+    fine for kernel unit tests, wrong as a default).
+    """
+    if name == "auto":
+        name = "flash" if jax.default_backend() == "tpu" else "xla"
     if name == "xla":
         return xla_attention
     if name == "flash":

@@ -114,6 +114,7 @@ class DeepSpeedEngine:
 
         # ---- step bookkeeping ----------------------------------------------------
         self.micro_steps = 0
+        self._host_steps = 0   # host mirror of state.global_step (see train_batch)
         self._grad_acc = None
         self._cached_grads = None
         self._last_metrics: Dict[str, Any] = {}
@@ -388,16 +389,21 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = np.float32(self.get_lr_value())
         self.state, metrics = jitted(self.state, gbatch, lr)
-        self.timers(TRAIN_BATCH_TIMER).stop()
+        self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=True)
 
+        # Host-side step mirror: the device counter (state.global_step) is exact but reading
+        # it forces a device sync per step; cadence decisions (print/monitor) use this mirror
+        # so the hot path never stalls the async dispatch queue. (Under fp16 overflow-skip the
+        # two can drift by the number of skipped steps; exact value remains at .global_steps.)
+        self._host_steps += 1
         self.micro_steps += self.gradient_accumulation_steps()
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
         self._write_monitor_events(metrics)
-        if self.global_steps % self._config.steps_per_print == 0:
-            log_dist(f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
+        if self._host_steps % self._config.steps_per_print == 0:
+            log_dist(f"step={self._host_steps} loss={float(metrics['loss']):.4f} "
                      f"lr={float(lr):.3e} loss_scale={float(metrics['loss_scale']):.0f}",
                      ranks=[0])
         return metrics["loss"]
@@ -464,10 +470,11 @@ class DeepSpeedEngine:
         self.state, metrics = self._fns["apply_step"](
             self.state, self._grad_acc, lr, self.gradient_accumulation_steps())
         self._grad_acc = None
+        self._host_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._last_metrics = metrics
-        self.timers(STEP_GLOBAL_TIMER).stop()
+        self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
         self._write_monitor_events(metrics)
 
     def eval_batch(self, batch):
@@ -480,7 +487,7 @@ class DeepSpeedEngine:
     def _write_monitor_events(self, metrics):
         if self.monitor is None or not getattr(self.monitor, "enabled", False):
             return
-        step = self.global_steps
+        step = self._host_steps
         events = [("Train/Samples/train_loss", float(metrics.get("loss", 0.0)), step),
                   ("Train/Samples/lr", self.get_lr_value(), step)]
         if self._config.fp16.enabled:
@@ -581,6 +588,7 @@ class DeepSpeedEngine:
             new_state = self.state._replace(params=new_state.params,
                                             global_step=new_state.global_step)
         self.state = new_state
+        self._host_steps = int(new_state.global_step)   # resync host mirror (one-off sync)
         side = self.checkpoint_engine.load(os.path.join(path, "client_state.pkl"))
         self.micro_steps = side.get("micro_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None \

@@ -142,6 +142,8 @@ class ThroughputTimer:
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
         self.steps_per_output = steps_per_output
+        self._window_start_step = 0
+        self._timed_steps = 0
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or logger.info
         # optional: flops per sample for TFLOPS reporting
@@ -152,10 +154,15 @@ class ThroughputTimer:
         self.micro_step_count = 0
 
     def start(self):
+        """Window-based timing: the per-step ``cuda.synchronize`` the reference does
+        (``utils/timer.py``) would stall XLA's async dispatch queue — instead we sync only at
+        ``steps_per_output`` window boundaries; the window wall-time divided by window steps is
+        the honest per-step time (device work in between stays fully pipelined)."""
         self.started = True
-        if self.global_step_count >= self.start_step:
+        if self.global_step_count >= self.start_step and self.start_time == 0.0:
             _sync()
             self.start_time = time.perf_counter()
+            self._window_start_step = self.global_step_count
 
     def stop(self, global_step: bool = False, report_speed: bool = True):
         if not self.started:
@@ -164,32 +171,38 @@ class ThroughputTimer:
         self.micro_step_count += 1
         if global_step:
             self.global_step_count += 1
-        if self.start_time > 0:
-            _sync()
-            self.end_time = time.perf_counter()
-            duration = self.end_time - self.start_time
-            self.total_elapsed_time += duration
-            self.step_elapsed_time += duration
-            self.start_time = 0.0
-            if global_step:
-                if report_speed and self.global_step_count % self.steps_per_output == 0:
-                    msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                           f"global_step={self.global_step_count}, "
-                           f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
-                           f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
-                    if self.flops_per_sample:
-                        tflops = (self.flops_per_sample * self.batch_size /
-                                  self.step_elapsed_time) / 1e12
-                        msg += f", TFLOPS={tflops:.2f}"
-                    if self.monitor_memory:
-                        msg += ", " + SynchronizedWallClockTimer.memory_usage()
-                    self.logging(msg)
-                # reset per-step accumulator every global step (reference timer.py:223),
-                # not only when reporting — otherwise CurrSamplesPerSec is ~window x too low
-                self.step_elapsed_time = 0.0
+        if self.start_time > 0 and global_step and \
+                self.global_step_count % self.steps_per_output == 0:
+            self._close_window()
+            if report_speed:
+                msg = (f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                       f"global_step={self.global_step_count}, "
+                       f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                       f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
+                if self.flops_per_sample:
+                    tflops = (self.flops_per_sample * self.batch_size /
+                              self.step_elapsed_time) / 1e12
+                    msg += f", TFLOPS={tflops:.2f}"
+                if self.monitor_memory:
+                    msg += ", " + SynchronizedWallClockTimer.memory_usage()
+                self.logging(msg)
+
+    def _close_window(self):
+        """Sync the device and fold the open timing window into the running totals."""
+        _sync()
+        self.end_time = time.perf_counter()
+        duration = self.end_time - self.start_time
+        window_steps = max(1, self.global_step_count - self._window_start_step)
+        self.total_elapsed_time += duration
+        self._timed_steps += window_steps
+        self.step_elapsed_time = duration / window_steps
+        self.start_time = 0.0
 
     def avg_samples_per_sec(self) -> float:
-        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
-            samples = self.batch_size * (self.global_step_count - self.start_step)
-            return samples / self.total_elapsed_time
+        # Runs shorter than steps_per_output have an open window — close it so short jobs
+        # still report a valid average instead of 0.
+        if self.start_time > 0 and self.global_step_count > self._window_start_step:
+            self._close_window()
+        if self._timed_steps > 0 and self.total_elapsed_time > 0:
+            return self.batch_size * self._timed_steps / self.total_elapsed_time
         return 0.0
