@@ -92,13 +92,16 @@ def bench_train():
     baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  ".bench_baseline.json")
     vs_baseline = 1.0
+    baseline = None
     try:
         if os.path.exists(baseline_file):
             with open(baseline_file) as f:
-                vs_baseline = tokens_per_sec_per_chip / json.load(f)["value"]
+                baseline = json.load(f)
+            vs_baseline = tokens_per_sec_per_chip / baseline["value"]
         else:
+            baseline = {"value": tokens_per_sec_per_chip, "micro_batch": micro, "seq": seq}
             with open(baseline_file, "w") as f:
-                json.dump({"value": tokens_per_sec_per_chip}, f)
+                json.dump(baseline, f)
     except Exception:
         pass
 
@@ -111,6 +114,12 @@ def bench_train():
         "micro_batch": micro,
         "seq": seq,
     }
+    # Surface the baseline's workload shape when known-different, so the ratio is readable
+    # as "same model/task, tuned config" rather than silently apples-to-oranges.
+    if baseline and baseline.get("micro_batch", micro) != micro:
+        out["baseline_micro_batch"] = baseline["micro_batch"]
+    if baseline and baseline.get("seq", seq) != seq:
+        out["baseline_seq"] = baseline["seq"]
     if peak:
         out["mfu"] = round(tflops_per_chip / peak, 4)
     print(json.dumps(out))

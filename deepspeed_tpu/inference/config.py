@@ -51,9 +51,19 @@ class DeepSpeedInferenceConfig(ConfigModel):
             return int(self.mp_size)
         return int(self.tensor_parallel.tp_size)
 
+    def is_int8(self) -> bool:
+        """int8 serving = int8-quantized weights + bf16 compute (grouped dequant at use).
+
+        The reference's int8 path is the same shape: ``GroupQuantizer`` quantizes weights at
+        injection (``module_inject/replace_module.py:152``) and kernels dequantize into fp16
+        compute (``csrc/transformer/inference/csrc/dequantize.cu``)."""
+        return str(self.dtype).replace("torch.", "") == "int8" or self.quant.enabled
+
     def jax_dtype(self):
         import jax.numpy as jnp
+        key = str(self.dtype).replace("torch.", "")
+        if key == "int8":
+            return jnp.bfloat16                   # compute dtype; weights quantized separately
         return {"float32": jnp.float32, "fp32": jnp.float32,
                 "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
-                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
-                "int8": jnp.bfloat16}[str(self.dtype).replace("torch.", "")]
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16}[key]

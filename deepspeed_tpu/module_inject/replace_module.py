@@ -129,6 +129,16 @@ def _convert_bloom(model) -> Tuple[CausalLMConfig, Any]:
 
 def _convert_opt(model) -> Tuple[CausalLMConfig, Any]:
     hf = model.config
+    # OPT variants this converter does not model: 350m's project_in/project_out
+    # (word_embed_proj_dim != hidden_size) and 125m/350m post-LN — fail loudly instead of
+    # converting to a silently wrong model.
+    if getattr(hf, "word_embed_proj_dim", hf.hidden_size) != hf.hidden_size:
+        raise NotImplementedError(
+            "OPT variants with word_embed_proj_dim != hidden_size (e.g. opt-350m) are not "
+            "supported")
+    if not getattr(hf, "do_layer_norm_before", True):
+        raise NotImplementedError(
+            "post-layernorm OPT variants (do_layer_norm_before=False) are not supported")
     cfg = opt_cfg(vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
                   n_embd=hf.hidden_size, n_layer=hf.num_hidden_layers,
                   n_head=hf.num_attention_heads, d_ff=hf.ffn_dim,

@@ -291,6 +291,14 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                              softmax_scale=softmax_scale,
                              dropout_rate=dropout_rate, dropout_rng=dropout_rng)
     b, t, h, d = q.shape
+    # VMEM guard: the fwd/dq kernels stage full-length K+V per batch-head (the dkv kernel
+    # full Q+dO); with Pallas double-buffering that is ~4·t·d·itemsize bytes, which must fit
+    # the ~16 MiB VMEM alongside block buffers. Beyond the budget, route to the XLA path —
+    # very long sequences belong to ring_attention (seq-axis sharding) anyway. TODO: stream
+    # K/V blocks from HBM via pltpu.make_async_copy (decode.py pattern) to lift this.
+    vmem_budget = 8 * 1024 * 1024
+    if 4 * t * d * q.dtype.itemsize > vmem_budget:
+        return xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
 
     def local(q4, k4, v4):

@@ -481,7 +481,12 @@ class DeepSpeedEngine:
         if "eval_step" not in self._fns:
             self._build_micro_fns()
         gb = self._globalize(batch)
-        rng = jax.random.fold_in(self._base_rng, 0x7FFFFFFF)
+        # dedicated eval rng stream, disjoint from the train stream by construction: train
+        # keys derive from fold_in(_base_rng, global_step) with global_step a non-negative
+        # int32, so folding -1 (0xFFFFFFFF as uint32, outside that range) roots a branch no
+        # train step can reach
+        self._eval_calls = getattr(self, "_eval_calls", 0) + 1
+        rng = jax.random.fold_in(jax.random.fold_in(self._base_rng, -1), self._eval_calls)
         return self._fns["eval_step"](self.state.params, gb, rng)
 
     def _write_monitor_events(self, metrics):
