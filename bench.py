@@ -128,9 +128,8 @@ def bench_train():
 def bench_inference():
     import numpy as np
 
-    import jax
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models import gpt2_cfg, causal_lm_model
+    from deepspeed_tpu.models import gpt2_cfg
 
     prompt_len = int(os.environ.get("BENCH_PROMPT", 512))
     gen_len = int(os.environ.get("BENCH_GEN", 128))
@@ -139,9 +138,8 @@ def bench_inference():
 
     cfg = gpt2_cfg(vocab_size=50304, max_seq_len=prompt_len + gen_len,
                    n_embd=768, n_layer=12, n_head=12)
-    model = causal_lm_model(cfg)
-    engine = ds.init_inference(model=model, config={"dtype": "bfloat16",
-                                                    "max_out_tokens": prompt_len + gen_len})
+    engine = ds.init_inference(model=cfg, config={"dtype": "bfloat16",
+                                                  "max_out_tokens": prompt_len + gen_len})
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 50304, size=(batch, prompt_len), dtype=np.int32)

@@ -68,7 +68,15 @@ class InferenceEngine:
                     jnp.zeros((1, 8), jnp.int32))["params"]
             return cfg, params
         if isinstance(model, tuple) and len(model) == 2:
-            return model  # (config, params)
+            cfg, params = model
+            if isinstance(cfg, CausalLMConfig):
+                return cfg, params
+            # our training models' (config, params): GPT2Config / GPT2MoEConfig
+            from ..models.gpt2 import GPT2Config
+            if isinstance(cfg, GPT2Config):
+                from ..module_inject.replace_module import convert_training_model
+                return convert_training_model(cfg, params)
+            return cfg, params
         # HF torch module → policy conversion (module_inject analogue)
         from ..module_inject.replace_module import convert_hf_model
         return convert_hf_model(model)
@@ -84,33 +92,139 @@ class InferenceEngine:
                     return False
         return True
 
-    def _shard_params(self):
-        specs = causal_lm_param_specs(self.params, tensor_axis=AXIS_TENSOR)
-        mesh = self.mesh_spec
+    # weight-path names eligible for int8 quantization (matmul kernels; embeddings and
+    # norms stay in fp — reference GroupQuantizer quantizes the same set)
+    _QUANT_NAMES = ("q_proj", "k_proj", "v_proj", "o_proj", "fc_in", "fc_out",
+                    "gate_proj", "up_proj", "lm_head")
 
-        def place(leaf, spec):
-            arr = jnp.asarray(leaf)
-            if arr.ndim >= 2 and arr.dtype in (jnp.float32, jnp.float16, jnp.bfloat16):
-                arr = arr.astype(self.dtype)  # matmul weights in serve dtype; norms fp32
+    def _shard_params(self):
+        self.params = self._place_params(self.params)
+
+    def _place_params(self, raw):
+        """Cast to serve dtype, optionally int8-quantize matmul weights (grouped symmetric,
+        reference ``GroupQuantizer``/``dequantize.cu``), and device_put with Megatron TP
+        specs. Quantized leaves become ``{"__int8_q__", "__int8_scale__"}`` nodes that
+        :meth:`_dequant` collapses inside the compiled graph."""
+        specs = causal_lm_param_specs(raw, tensor_axis=AXIS_TENSOR)
+        mesh = self.mesh_spec
+        int8 = self._config.is_int8()
+        self._raw_template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), getattr(x, "dtype", np.float32)),
+            raw)
+
+        def put(arr, spec):
             if not self._spec_fits(arr.shape, spec):
                 spec = P(*([None] * arr.ndim))
             return jax.device_put(arr, NamedSharding(mesh.mesh, spec))
 
-        self.params = jax.tree_util.tree_map(place, self.params, specs)
+        def quantizable(path_tuple, arr):
+            if arr.ndim < 2:
+                return False
+            names = set(path_tuple)
+            if names & set(self._QUANT_NAMES) and path_tuple[-1] == "kernel":
+                return True
+            return "moe_experts" in names and path_tuple[-1] in ("w1", "w2")
+
+        def walk(node, spec_node, path):
+            if isinstance(node, dict):
+                return {k: walk(v, spec_node[k], path + (k,)) for k, v in node.items()}
+            arr = jnp.asarray(node)
+            if arr.ndim >= 2 and arr.dtype in (jnp.float32, jnp.float16, jnp.bfloat16):
+                arr = arr.astype(self.dtype)
+            if int8 and quantizable(path, arr):
+                from ..ops.quantizer import quantize_grouped
+                q, scale = quantize_grouped(arr)
+                spec_t = tuple(spec_node) + (None,) * (arr.ndim - len(tuple(spec_node)))
+                return {"__int8_q__": put(q, P(*spec_t)),
+                        "__int8_scale__": put(scale.astype(jnp.float32), P(*spec_t))}
+            return put(arr, spec_node)
+
+        placed = walk(raw, specs, ())
         self._param_specs = specs
+        self._quantized = int8
+        return placed
+
+    def _dequant(self, params):
+        """Collapse int8 nodes to fp weights inside a traced computation (XLA fuses the
+        dequant into the consuming matmul's operand read)."""
+        if not getattr(self, "_quantized", False):
+            return params
+
+        def walk(node):
+            if isinstance(node, dict):
+                if "__int8_q__" in node:
+                    from ..ops.quantizer import dequantize_grouped
+                    return dequantize_grouped(
+                        node["__int8_q__"], node["__int8_scale__"]).astype(self.dtype)
+                return {k: walk(v) for k, v in node.items()}
+            return node
+
+        return walk(params)
 
     # ------------------------------------------------------------------ compiled steps
     def _build_fns(self):
         self._fns["forward"] = jax.jit(
-            lambda params, ids: self.module.apply({"params": params}, ids))
+            lambda params, ids: self.module.apply(
+                {"params": self._dequant(params)}, ids))
 
-    def _sampled_fns(self, do_sample, temperature, top_k, top_p):
-        """Prefill/decode steps with token selection fused in — one dispatch per decode
-        step, no eager ops in the loop (the XLA analogue of CUDA-graph replay)."""
-        key = ("gen", do_sample, float(temperature), int(top_k), float(top_p))
+    def _loop_fns(self, do_sample, temperature, top_k, top_p, gen_cap):
+        """Device-resident generation: prefill (first token, synced for TTFT) + ONE compiled
+        ``lax.while_loop`` for all remaining tokens — the XLA analogue of CUDA-graph replay
+        (reference ``_create_cuda_graph:479``) with zero host round-trips in the decode loop;
+        EOS termination is an on-device all-reduce in the loop condition."""
+        key = ("loop", do_sample, float(temperature), int(top_k), float(top_p), gen_cap)
         if key in self._fns:
             return self._fns[key]
         module = self.module
+        select = self._select_fn(do_sample, temperature, top_k, top_p)
+
+        def prefill(params, ids, caches, lens0, rng):
+            # ids may be right-padded: next-token logits are read at each sequence's
+            # last *valid* position, not at column -1
+            logits, new_caches = module.apply(
+                {"params": self._dequant(params)}, ids, caches=caches,
+                cache_lens=jnp.zeros_like(lens0))
+            b = ids.shape[0]
+            last = logits[jnp.arange(b), jnp.maximum(lens0 - 1, 0)]
+            return select(last, rng), new_caches, lens0
+
+        def decode_loop(params, tok0, caches, lens, n_new, eos, rng):
+            b = tok0.shape[0]
+            buf = jnp.zeros((b, gen_cap), jnp.int32).at[:, 0].set(tok0[:, 0])
+            finished0 = tok0[:, 0] == eos          # eos = -1 when unused: never matches
+
+            def cond(s):
+                i, _, _, _, finished, _ = s
+                return jnp.logical_and(i < n_new, jnp.logical_not(jnp.all(finished)))
+
+            def body(s):
+                i, tok, caches, lens, finished, buf = s
+                positions = lens[:, None]
+                logits, caches = module.apply(
+                    {"params": self._dequant(params)}, tok, positions=positions,
+                    caches=caches, cache_lens=lens)
+                tok = select(logits[:, -1], jax.random.fold_in(rng, i))
+                # finished sequences keep emitting eos (HF pad-with-eos behaviour)
+                tok = jnp.where(finished[:, None], jnp.maximum(eos, 0), tok)
+                finished = jnp.logical_or(finished, tok[:, 0] == eos)
+                buf = buf.at[:, i].set(tok[:, 0])
+                return i + 1, tok, caches, lens + 1, finished, buf
+
+            # lens is each sequence's append position: the prompt's true length (generated
+            # tokens overwrite right-pad slots in the cache; decode masks by cache_len)
+            state = (jnp.int32(1), tok0, caches, lens, finished0, buf)
+            n, _, _, _, _, buf = jax.lax.while_loop(cond, body, state)
+            return buf, n
+
+        # No donation on either fn: prefill rebuilds cache buffers (pad-write) and the loop
+        # reuses its carry buffers internally — donating caches cannot alias any output
+        # (they are not returned) and only produces "donated buffer not usable" warnings.
+        fns = (jax.jit(prefill), jax.jit(decode_loop))
+        self._fns[key] = fns
+        return fns
+
+    def _select_fn(self, do_sample, temperature, top_k, top_p):
+        """Token-selection closure shared by the generation paths."""
 
         def select(logits, rng):
             if not do_sample:
@@ -128,23 +242,7 @@ class InferenceEngine:
                 x = jnp.where(x < cutoff, -jnp.inf, x)
             return jax.random.categorical(rng, x, axis=-1)[:, None]
 
-        def prefill(params, ids, caches, lens0, rng):
-            logits, new_caches = module.apply(
-                {"params": params}, ids, caches=caches, cache_lens=lens0)
-            lens = lens0 + ids.shape[1]
-            return select(logits[:, -1], rng), new_caches, lens
-
-        def decode(params, tok, caches, lens, rng):
-            positions = lens[:, None]
-            logits, new_caches = module.apply(
-                {"params": params}, tok, positions=positions,
-                caches=caches, cache_lens=lens)
-            return select(logits[:, -1], rng), new_caches, lens + 1
-
-        fns = (jax.jit(prefill, donate_argnums=(2,)),
-               jax.jit(decode, donate_argnums=(2,)))
-        self._fns[key] = fns
-        return fns
+        return select
 
     # ------------------------------------------------------------------ API
     def _activate(self):
@@ -164,41 +262,65 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
-                 eos_token_id: Optional[int] = None, seed: int = 0, **kwargs):
-        """Greedy/sampled generation with the AOT decode loop
-        (reference ``_generate:571`` guard + HF-style knobs). Returns (b, t+new) tokens."""
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 attention_mask=None, prompt_lengths=None, **kwargs):
+        """Greedy/sampled generation, fully device-resident (reference ``_generate:571``
+        guard + HF-style knobs). Returns (b, t+generated) tokens.
+
+        The decode loop is ONE compiled ``lax.while_loop`` dispatch — no per-token host
+        round-trips; EOS termination happens on device. TTFT (``self.ttft``) is measured by
+        host-syncing the prefill's first token.
+
+        Unequal-length prompts: pass ``attention_mask`` (HF-style 0/1, must be
+        right-padded) or ``prompt_lengths``; positions, the prefill's next-token read and
+        the KV append point are then per-sequence (generated tokens overwrite pad slots).
+        """
         if kwargs.get("num_beams", 1) != 1:
             raise NotImplementedError("beam search is not supported (reference parity: "
                                       "DeepSpeed inference rejects num_beams > 1)")
         self._activate()
         ids = np.asarray(input_ids)
         b, t = ids.shape
+
+        if attention_mask is not None:
+            am = np.asarray(attention_mask).astype(bool)
+            lens_np = am.sum(axis=1).astype(np.int32)
+            expect = np.arange(t)[None, :] < lens_np[:, None]
+            if not np.array_equal(am, expect):
+                raise ValueError("attention_mask must be right-padded (1s then 0s); "
+                                 "left-padded prompts are not supported")
+            if (lens_np < 1).any():
+                raise ValueError("attention_mask rows must contain at least one valid token")
+        elif prompt_lengths is not None:
+            lens_np = np.asarray(prompt_lengths, dtype=np.int32)
+            if lens_np.shape != (b,) or (lens_np < 1).any() or (lens_np > t).any():
+                raise ValueError(f"prompt_lengths must be (b,) in [1, {t}]")
+        else:
+            lens_np = np.full((b,), t, dtype=np.int32)
+
         cap = max(self._config.max_out_tokens, t + max_new_tokens)
-        prefill, decode = self._sampled_fns(do_sample, temperature, top_k, top_p)
+        # buffer sized by the prompt-independent cap so the decode loop compiles ONCE per
+        # (cap, sampling config, batch) — varying prompt lengths only recompile prefill
+        gen_cap = cap
+        prefill, decode_loop = self._loop_fns(do_sample, temperature, top_k, top_p,
+                                              gen_cap)
 
         caches = init_cache(self.model_config, b, cap, dtype=self.dtype)
-        lens0 = jnp.zeros((b,), jnp.int32)
+        lens0 = jnp.asarray(lens_np)
         rng = jax.random.PRNGKey(seed)
         t0 = time.perf_counter()
-        tok, caches, lens = prefill(self.params, jnp.asarray(ids), caches, lens0,
-                                    jax.random.fold_in(rng, 0))
-        jax.block_until_ready(tok)
+        tok0, caches, lens = prefill(self.params, jnp.asarray(ids), caches, lens0,
+                                     jax.random.fold_in(rng, 0))
+        tok0_np = np.asarray(tok0)                      # host sync: honest TTFT
         self.ttft = time.perf_counter() - t0
 
-        out = [ids]
-        finished = np.zeros((b,), dtype=bool)
-        for step in range(max_new_tokens):
-            tok_np = np.asarray(tok)
-            if eos_token_id is not None:
-                tok_np = np.where(finished[:, None], eos_token_id, tok_np)
-                finished |= tok_np[:, 0] == eos_token_id
-            out.append(tok_np)
-            if step == max_new_tokens - 1 or (eos_token_id is not None
-                                              and finished.all()):
-                break
-            tok, caches, lens = decode(self.params, jnp.asarray(tok_np), caches, lens,
-                                       jax.random.fold_in(rng, step + 1))
-        return np.concatenate(out, axis=1)
+        eos = np.int32(-1 if eos_token_id is None else eos_token_id)
+        # n is bounded by cache room: the last appended KV lands at position t+n-2 < cap
+        buf, n = decode_loop(self.params, tok0, caches, lens,
+                             np.int32(min(max_new_tokens, cap - t + 1)), eos, rng)
+        n = int(n)
+        gen = np.asarray(buf)[:, :n]
+        return np.concatenate([ids, gen], axis=1)
 
     # ------------------------------------------------------------------ checkpoints
     def load_checkpoint(self, ckpt_dir: str, tag: Optional[str] = None):
@@ -214,6 +336,10 @@ class InferenceEngine:
         shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh_spec.mesh, s), self._param_specs,
             is_leaf=lambda x: isinstance(x, P))
-        self.params = eng.load_subtree(os.path.join(path, "state"), "params",
-                                       template=self.params, shardings=shardings)
+        # checkpoints hold fp params: restore against the pre-quantization template, then
+        # re-run placement (cast + optional int8 quantization + sharding)
+        restored = eng.load_subtree(os.path.join(path, "state"), "params",
+                                    template=self._raw_template, shardings=shardings)
+        self.params = self._place_params(restored)
+        self._fns.clear()                       # param tree structure may have changed
         logger.info(f"inference params loaded from {path}")

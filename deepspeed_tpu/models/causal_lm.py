@@ -52,6 +52,14 @@ class CausalLMConfig:
     dtype: Any = jnp.bfloat16
     init_std: float = 0.02
     name: str = "causal-lm"
+    # MoE serving (reference ``ops/transformer/inference/moe_inference.py``): every
+    # ``moe_layer_interval``-th layer's FFN is a gated expert mixture. 0 experts = dense.
+    num_experts: int = 0
+    moe_layer_interval: int = 2
+    moe_top_k: int = 1
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.num_experts > 0 and (i + 1) % self.moe_layer_interval == 0
 
     @property
     def head_dim(self) -> int:
@@ -70,7 +78,10 @@ class CausalLMConfig:
         f = self.ffn_dim
         mlp = d * f * (3 if self.gated_mlp else 2)
         attn = d * d + 2 * d * self.kv_heads * self.head_dim + d * d
-        return v * d + L * (attn + mlp) + (0 if self.tie_word_embeddings else v * d)
+        n_moe = sum(1 for i in range(L) if self.is_moe_layer(i))
+        moe_extra = n_moe * (self.num_experts - 1) * 2 * d * f  # experts replace the FFN
+        return (v * d + L * (attn + mlp) + moe_extra +
+                (0 if self.tie_word_embeddings else v * d))
 
 
 # ---------------------------------------------------------------- family constructors
@@ -180,6 +191,7 @@ def _act(cfg: CausalLMConfig):
 # ----------------------------------------------------------------------- modules
 class CausalLMLayer(nn.Module):
     config: CausalLMConfig
+    is_moe: bool = False
 
     def _attn_proj(self, x):
         cfg = self.config
@@ -211,6 +223,28 @@ class CausalLMLayer(nn.Module):
             h = act(h)
         return nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
                         kernel_init=proj_init, name="fc_out")(h)
+
+    def _moe_mlp(self, h):
+        """Gated expert-mixture FFN for serving (reference ``moe_inference.py``: gating +
+        einsum dispatch in the decode path). Eval-mode gating: deterministic, no token drop
+        (static capacity = token count — the reference's inference MoE has no capacity
+        dropping either; a capacity-trained model may therefore route overflow tokens that
+        training-time eval would have dropped), experts sharded over the ``expert`` axis."""
+        from ..moe.experts import Experts
+        from ..moe.sharded_moe import TopKGate, moe_dispatch_combine
+        cfg = self.config
+        b, t, d = h.shape
+        x = h.reshape(b * t, d)
+        wg = self.param("moe_gate", nn.initializers.normal(cfg.init_std),
+                        (d, cfg.num_experts), jnp.float32)
+        gate = TopKGate(k=cfg.moe_top_k, drop_tokens=False, use_rts=False,
+                        top2_2nd_expert_sampling=False)
+        _, combine, dispatch, _ = gate(wg, x, train=False, rng=None)
+        experts = Experts(num_experts=cfg.num_experts, d_model=d, d_ff=cfg.ffn_dim,
+                          activation=_act(cfg), dtype=cfg.dtype, init_std=cfg.init_std,
+                          name="moe_experts")
+        out = moe_dispatch_combine(x, combine, dispatch, experts)
+        return out.reshape(b, t, d).astype(h.dtype)
 
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Dict] = None,
@@ -260,13 +294,14 @@ class CausalLMLayer(nn.Module):
         attn_out = nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
                             kernel_init=proj_init, name="o_proj")(o)
 
+        mlp = self._moe_mlp if self.is_moe else self._mlp
         if cfg.parallel_residual:
             h_mlp = _norm(cfg, "ln_mlp")(x).astype(cfg.dtype)
-            y = x + attn_out + self._mlp(h_mlp)
+            y = x + attn_out + mlp(h_mlp)
         else:
             x = x + attn_out
             h_mlp = _norm(cfg, "ln_mlp")(x).astype(cfg.dtype)
-            y = x + self._mlp(h_mlp)
+            y = x + mlp(h_mlp)
         return y, new_kv
 
 
@@ -375,7 +410,8 @@ class CausalLM(nn.Module):
         new_caches = []
         for i in range(cfg.n_layer):
             layer_cache = None if caches is None else caches[i]
-            x, new_kv = CausalLMLayer(cfg, name=f"layers_{i}")(
+            x, new_kv = CausalLMLayer(cfg, is_moe=cfg.is_moe_layer(i),
+                                      name=f"layers_{i}")(
                 x, positions, cache=layer_cache, cache_len=cache_lens)
             new_caches.append(new_kv)
 
@@ -438,6 +474,13 @@ def causal_lm_param_specs(params, tensor_axis: str = "tensor") -> Any:
     def spec_for(path_str: str, ndim: int):
         col = ("q_proj", "k_proj", "v_proj", "fc_in", "gate_proj", "up_proj")
         row = ("o_proj", "fc_out")
+        if "/moe_experts/" in path_str:
+            # expert dim over the expert axis (reference EP serving: experts split across
+            # ranks at load, ``moe_inference.py``)
+            from ..parallel.mesh import AXIS_EXPERT
+            return P(AXIS_EXPERT, *([None] * (ndim - 1)))
+        if path_str.endswith("moe_gate"):
+            return P(*([None] * ndim))
         if any(f"/{n}/" in path_str or path_str.endswith(f"{n}/kernel") for n in col):
             if path_str.endswith("kernel"):
                 return P(None, tensor_axis)

@@ -1,1 +1,2 @@
-from .replace_module import (HF_POLICIES, convert_hf_model, replace_transformer_layer)
+from .replace_module import (HF_POLICIES, convert_hf_model, convert_training_model,
+                             replace_transformer_layer)

@@ -257,6 +257,72 @@ def convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
     return HF_POLICIES[model_type](model)
 
 
+def convert_training_model(train_cfg, params) -> Tuple[CausalLMConfig, Any]:
+    """Convert OUR training models' param trees (GPT2 / GPT2MoE, ``models/gpt2*.py``) into
+    the :class:`CausalLM` serving tree — the in-framework analogue of the reference's
+    Megatron state-dict loader (``runtime/state_dict_factory.py:214``): train, checkpoint,
+    then serve through the inference engine with KV caches.
+
+    Handles both scan-stacked (``h`` with leading layer dim) and unstacked (``h_{i}`` /
+    ``h_moe_{i}``) layouts.
+    """
+    import jax
+
+    num_experts = int(getattr(train_cfg, "num_experts", 0) or 0)
+    cfg = gpt2_cfg(vocab_size=train_cfg.vocab_size, max_seq_len=train_cfg.n_positions,
+                   n_embd=train_cfg.n_embd, n_layer=train_cfg.n_layer,
+                   n_head=train_cfg.n_head, num_experts=num_experts,
+                   moe_layer_interval=getattr(train_cfg, "moe_layer_interval", 2),
+                   moe_top_k=getattr(train_cfg, "top_k", 1))
+    params = jax.tree_util.tree_map(np.asarray, params)
+
+    def dense_layer(blk):
+        qkv_k = np.split(np.asarray(blk["c_attn"]["kernel"]), 3, axis=1)
+        qkv_b = np.split(np.asarray(blk["c_attn"]["bias"]), 3, axis=0)
+        return {
+            "ln_attn": blk["ln_1"], "ln_mlp": blk["ln_2"],
+            "q_proj": {"kernel": qkv_k[0], "bias": qkv_b[0]},
+            "k_proj": {"kernel": qkv_k[1], "bias": qkv_b[1]},
+            "v_proj": {"kernel": qkv_k[2], "bias": qkv_b[2]},
+            "o_proj": blk["c_proj"],
+            "fc_in": blk["c_fc"],
+            "fc_out": blk["mlp_c_proj"],
+        }
+
+    def moe_layer(blk):
+        if "residual_fc1" in blk.get("moe", {}):
+            raise NotImplementedError("residual-MoE serving is not supported")
+        qkv_k = np.split(np.asarray(blk["c_attn"]["kernel"]), 3, axis=1)
+        qkv_b = np.split(np.asarray(blk["c_attn"]["bias"]), 3, axis=0)
+        return {
+            "ln_attn": blk["ln_1"], "ln_mlp": blk["ln_2"],
+            "q_proj": {"kernel": qkv_k[0], "bias": qkv_b[0]},
+            "k_proj": {"kernel": qkv_k[1], "bias": qkv_b[1]},
+            "v_proj": {"kernel": qkv_k[2], "bias": qkv_b[2]},
+            "o_proj": blk["c_proj"],
+            "moe_gate": blk["moe"]["gate_wg"],
+            "moe_experts": blk["moe"]["experts"],
+        }
+
+    new = {"wte": params["wte"], "wpe": params["wpe"], "ln_f": params["ln_f"]}
+    if "h" in params:  # scan-stacked homogeneous body
+        stacked = params["h"]
+        for i in range(cfg.n_layer):
+            blk = jax.tree_util.tree_map(lambda x: x[i], stacked)
+            new[f"layers_{i}"] = dense_layer(blk)
+    else:
+        for i in range(cfg.n_layer):
+            if f"h_moe_{i}" in params:
+                new[f"layers_{i}"] = moe_layer(params[f"h_moe_{i}"])
+            elif f"h_{i}" in params:
+                new[f"layers_{i}"] = dense_layer(params[f"h_{i}"])
+            else:
+                raise KeyError(f"layer {i} not found in training params "
+                               f"(expected 'h', 'h_{i}' or 'h_moe_{i}')")
+    new = jax.tree_util.tree_map(jnp.asarray, new)
+    return cfg, new
+
+
 def replace_transformer_layer(orig_layer_impl, model, checkpoint=None, config=None,
                               **kwargs):
     """Reference-named API shim (``replace_module.py:308``): returns the converted

@@ -1,0 +1,1 @@
+from .quant import DEFAULT_GROUP, dequantize_grouped, quantize_grouped

@@ -24,6 +24,16 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Tests that activate a mesh (engines, shard_map paths) must not leak it into
+    later tests — the global mesh is process state, like the reference's cached process
+    groups (``groups.py``)."""
+    yield
+    from deepspeed_tpu.parallel.mesh import set_global_mesh
+    set_global_mesh(None)
+
+
 @pytest.fixture
 def eight_devices():
     devs = jax.devices()

@@ -1,0 +1,95 @@
+"""MoE + trained-checkpoint serving tests.
+
+Reference surface: ``ops/transformer/inference/moe_inference.py`` (MoE decode path) and
+``runtime/state_dict_factory.py`` (loading trained checkpoints for serving).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.models import (GPT2Config, GPT2MoEConfig, gpt2_model, gpt2_moe_model)
+from deepspeed_tpu.parallel.mesh import MeshSpec, set_global_mesh
+
+
+def _train_params(model, seed=0):
+    set_global_mesh(None)
+    return jax.jit(model.init_fn)(jax.random.PRNGKey(seed))
+
+
+def _greedy_rollout(apply_fn, params, ids, steps):
+    """Ground truth: the TRAINING model's full forward + argmax each step."""
+    cur = np.asarray(ids)
+    for _ in range(steps):
+        logits = apply_fn(params, {"input_ids": jnp.asarray(cur)})
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))[:, None]
+        cur = np.concatenate([cur, nxt.astype(cur.dtype)], axis=1)
+    return cur
+
+
+def test_serve_trained_moe_model():
+    """gpt2_moe training params convert and serve through InferenceEngine: the cached MoE
+    decode path reproduces the training model's greedy rollout."""
+    # eval_capacity_factor high enough that the training model's eval path provably drops
+    # nothing — serving routes ALL tokens (no capacity, like the reference's inference
+    # MoE), so exact parity requires a drop-free training reference
+    cfg = GPT2MoEConfig(vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+                        dropout=0.0, num_experts=4, moe_layer_interval=2, top_k=1,
+                        eval_capacity_factor=64.0, dtype=jnp.float32, scan_layers=False)
+    model = gpt2_moe_model(cfg, sample_seq_len=16)
+    params = _train_params(model)
+
+    engine = InferenceEngine((cfg, params), ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    assert engine.model_config.num_experts == 4
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 96, size=(2, 8)).astype(np.int32)
+    out = engine.generate(ids, max_new_tokens=5)
+    ref = _greedy_rollout(model.apply_fn, params, ids, 5)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_serve_trained_dense_scan_model():
+    """Scan-stacked training GPT-2 params convert (unstack + qkv split) and serve."""
+    cfg = GPT2Config(vocab_size=96, n_positions=64, n_embd=32, n_layer=3, n_head=4,
+                     dropout=0.0, dtype=jnp.float32, scan_layers=True,
+                     attention_impl="xla")
+    model = gpt2_model(cfg, sample_seq_len=16)
+    params = _train_params(model)
+
+    engine = InferenceEngine((cfg, params), ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 96, size=(2, 8)).astype(np.int32)
+    out = engine.generate(ids, max_new_tokens=5)
+    ref = _greedy_rollout(model.apply_fn, params, ids, 5)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_moe_expert_sharding_at_load(eight_devices):
+    """Experts land sharded over the expert mesh axis and TP+EP serving matches 1-device."""
+    cfg = GPT2MoEConfig(vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+                        dropout=0.0, num_experts=4, moe_layer_interval=2, top_k=1,
+                        eval_capacity_factor=64.0, dtype=jnp.float32, scan_layers=False)
+    model = gpt2_moe_model(cfg, sample_seq_len=16)
+    params = _train_params(model)
+
+    e1 = InferenceEngine((cfg, jax.tree_util.tree_map(np.asarray, params)),
+                         ds.inference.DeepSpeedInferenceConfig(
+                             dtype="float32", max_out_tokens=64),
+                         mesh_spec=MeshSpec({"expert": 1}, eight_devices[:1]))
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 96, size=(2, 8)).astype(np.int32)
+    out1 = e1.generate(ids, max_new_tokens=4)
+
+    e2 = InferenceEngine((cfg, jax.tree_util.tree_map(np.asarray, params)),
+                         ds.inference.DeepSpeedInferenceConfig(
+                             dtype="float32", max_out_tokens=64),
+                         mesh_spec=MeshSpec({"expert": 4}, eight_devices[:4]))
+    out2 = e2.generate(ids, max_new_tokens=4)
+    np.testing.assert_array_equal(out1, out2)
+    w1 = e2.params["layers_1"]["moe_experts"]["w1"]
+    assert "expert" in str(w1.sharding.spec)
