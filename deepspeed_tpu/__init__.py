@@ -90,3 +90,5 @@ def argparse_suppress():
 
 from . import inference  # noqa: F401,E402  (init_inference config surface)
 from . import moe  # noqa: F401,E402
+from .runtime.activation_checkpointing import checkpointing  # noqa: F401,E402
+from .profiling.flops_profiler import get_model_profile  # noqa: F401,E402

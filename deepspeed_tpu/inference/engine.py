@@ -281,6 +281,8 @@ class InferenceEngine:
         self._activate()
         ids = np.asarray(input_ids)
         b, t = ids.shape
+        if max_new_tokens <= 0:
+            return ids
 
         if attention_mask is not None:
             am = np.asarray(attention_mask).astype(bool)
@@ -315,9 +317,10 @@ class InferenceEngine:
         self.ttft = time.perf_counter() - t0
 
         eos = np.int32(-1 if eos_token_id is None else eos_token_id)
-        # n is bounded by cache room: the last appended KV lands at position t+n-2 < cap
+        # cache room is guaranteed: cap >= t + max_new_tokens, and the last appended KV
+        # lands at position t + max_new_tokens - 2 < cap
         buf, n = decode_loop(self.params, tok0, caches, lens,
-                             np.int32(min(max_new_tokens, cap - t + 1)), eos, rng)
+                             np.int32(max_new_tokens), eos, rng)
         n = int(n)
         gen = np.asarray(buf)[:, :n]
         return np.concatenate([ids, gen], axis=1)

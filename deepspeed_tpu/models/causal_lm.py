@@ -189,6 +189,25 @@ def _act(cfg: CausalLMConfig):
 
 
 # ----------------------------------------------------------------------- modules
+class _ExpertWeights(nn.Module):
+    """Param holder producing the same tree as the training ``moe.experts.Experts``
+    module (``moe_experts/{w1,b1,w2,b2}``) so trained checkpoints map 1:1; the routing
+    math lives in the caller where it can be vmapped over token chunks."""
+    num_experts: int
+    d_model: int
+    d_ff: int
+    init_std: float
+
+    @nn.compact
+    def __call__(self):
+        e, d, f = self.num_experts, self.d_model, self.d_ff
+        init = nn.initializers.normal(self.init_std)
+        return (self.param("w1", init, (e, d, f), jnp.float32),
+                self.param("b1", nn.initializers.zeros, (e, f), jnp.float32),
+                self.param("w2", init, (e, f, d), jnp.float32),
+                self.param("b2", nn.initializers.zeros, (e, d), jnp.float32))
+
+
 class CausalLMLayer(nn.Module):
     config: CausalLMConfig
     is_moe: bool = False
@@ -224,26 +243,66 @@ class CausalLMLayer(nn.Module):
         return nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
                         kernel_init=proj_init, name="fc_out")(h)
 
+    # prefill tokens are routed in chunks of this size: the one-hot dispatch/combine
+    # tensors are (C, e, C) per chunk — linear total memory/flops in token count instead
+    # of the quadratic (s, e, s) a whole-sequence no-drop dispatch would build
+    MOE_CHUNK = 256
+
     def _moe_mlp(self, h):
         """Gated expert-mixture FFN for serving (reference ``moe_inference.py``: gating +
         einsum dispatch in the decode path). Eval-mode gating: deterministic, no token drop
-        (static capacity = token count — the reference's inference MoE has no capacity
-        dropping either; a capacity-trained model may therefore route overflow tokens that
-        training-time eval would have dropped), experts sharded over the ``expert`` axis."""
-        from ..moe.experts import Experts
-        from ..moe.sharded_moe import TopKGate, moe_dispatch_combine
+        (chunked dispatch with capacity = chunk size — routing is per-token, so chunking
+        does not change results; the reference's inference MoE has no capacity dropping
+        either), experts sharded over the ``expert`` axis."""
+        from ..moe.sharded_moe import TopKGate
         cfg = self.config
         b, t, d = h.shape
-        x = h.reshape(b * t, d)
+        s = b * t
+        x = h.reshape(s, d)
         wg = self.param("moe_gate", nn.initializers.normal(cfg.init_std),
                         (d, cfg.num_experts), jnp.float32)
         gate = TopKGate(k=cfg.moe_top_k, drop_tokens=False, use_rts=False,
                         top2_2nd_expert_sampling=False)
-        _, combine, dispatch, _ = gate(wg, x, train=False, rng=None)
-        experts = Experts(num_experts=cfg.num_experts, d_model=d, d_ff=cfg.ffn_dim,
-                          activation=_act(cfg), dtype=cfg.dtype, init_std=cfg.init_std,
-                          name="moe_experts")
-        out = moe_dispatch_combine(x, combine, dispatch, experts)
+        # bind expert weights ONCE at this scope (params: moe_experts/{w1,b1,w2,b2}, same
+        # tree as the training Experts module), then route with pure math — safe to vmap
+        w1, b1, w2, b2 = _ExpertWeights(cfg.num_experts, d, cfg.ffn_dim, cfg.init_std,
+                                        name="moe_experts")()
+        act = _act(cfg)
+        cdtype = cfg.dtype
+
+        def expert_fn(expert_in):                       # (e, c, m) → (e, c, m)
+            hh = jnp.einsum("ecm,emf->ecf", expert_in, w1.astype(cdtype)) + \
+                b1[:, None, :].astype(cdtype)
+            hh = act(hh)
+            return jnp.einsum("ecf,efm->ecm", hh, w2.astype(cdtype)) + \
+                b2[:, None, :].astype(cdtype)
+
+        def gating(tokens):                             # pure math, safe under vmap
+            _, combine, dispatch, _ = gate(wg, tokens, train=False, rng=None)
+            return combine, dispatch
+
+        from ..parallel.mesh import AXIS_EXPERT, get_global_mesh
+        mesh = get_global_mesh()
+        e = cfg.num_experts
+        chunk = min(s, self.MOE_CHUNK)
+        pad = (-s) % chunk
+        xc = jnp.pad(x, ((0, pad), (0, 0))).reshape(-1, chunk, d)     # (n, C, m)
+        n = xc.shape[0]
+        combine, dispatch = jax.vmap(gating)(xc)                      # (n, C, e, C)
+        expert_in = jnp.einsum("nsec,nsm->encm", dispatch.astype(jnp.float32),
+                               xc.astype(jnp.float32)).astype(cdtype)
+        expert_in = expert_in.reshape(e, n * chunk, d)
+        if mesh is not None and mesh.size(AXIS_EXPERT) > 1:
+            expert_in = jax.lax.with_sharding_constraint(
+                expert_in, mesh.sharding(P(AXIS_EXPERT, None, None)))
+        expert_out = expert_fn(expert_in)                             # (e, nC, m)
+        if mesh is not None and mesh.size(AXIS_EXPERT) > 1:
+            expert_out = jax.lax.with_sharding_constraint(
+                expert_out, mesh.sharding(P(AXIS_EXPERT, None, None)))
+        expert_out = expert_out.reshape(e, n, chunk, d)
+        out = jnp.einsum("nsec,encm->nsm", combine.astype(jnp.float32),
+                         expert_out.astype(jnp.float32))
+        out = out.reshape(-1, d)[:s]
         return out.reshape(b, t, d).astype(h.dtype)
 
     @nn.compact

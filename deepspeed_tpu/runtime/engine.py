@@ -168,11 +168,13 @@ class DeepSpeedEngine:
         return None
 
     def _configure_monitor(self):
-        try:
-            from ..monitor.monitor import MonitorMaster
-            return MonitorMaster(self._config.monitor_config)
-        except Exception:
-            return None
+        from ..monitor.monitor import MonitorMaster
+        monitor = MonitorMaster(self._config.monitor_config)
+        if self._config.monitor_config.enabled and not monitor.enabled \
+                and dist.get_rank() == 0:
+            log_dist("monitor enabled in config but no backend initialised "
+                     "(see warnings above)", ranks=[0])
+        return monitor
 
     def _configure_dataloader(self, training_data):
         if training_data is None:
@@ -385,6 +387,10 @@ class DeepSpeedEngine:
         local = self._reshape_for_gas(batch)
         gbatch = self._globalize(local, leading_gas=True)
 
+        fp_cfg = self._config.flops_profiler
+        if fp_cfg.enabled and self._host_steps + 1 == fp_cfg.profile_step:
+            self._run_flops_profiler(gbatch)
+
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = np.float32(self.get_lr_value())
@@ -407,6 +413,28 @@ class DeepSpeedEngine:
                      f"lr={float(lr):.3e} loss_scale={float(metrics['loss_scale']):.0f}",
                      ranks=[0])
         return metrics["loss"]
+
+    def _run_flops_profiler(self, gbatch):
+        """One-shot train-step profile at ``flops_profiler.profile_step``
+        (reference ``engine.py:1791-1800`` wiring)."""
+        from ..profiling.flops_profiler import FlopsProfiler
+        profiler = FlopsProfiler(self._config.flops_profiler)
+        lr = np.float32(self.get_lr_value())
+
+        def step_fn(state, batch):
+            jitted = self._fns["train_step"]
+            return jitted(state, batch, lr)
+
+        try:
+            profiler.profile_step(lambda s, b: step_fn(s, b), self.state, gbatch,
+                                  depth=self._config.flops_profiler.module_depth
+                                  if self._config.flops_profiler.module_depth >= 0 else 2)
+            sps = self.tput_timer.avg_samples_per_sec() or None
+            tput = (sps / self.train_batch_size()) if sps else None
+            profiler.print_model_profile(throughput_per_sec=tput)
+            self.flops_profiler = profiler
+        except Exception as e:
+            log_dist(f"flops profiler failed: {e}", ranks=[0])
 
     def _next_train_batch(self):
         if not hasattr(self, "_train_iter") or self._train_iter is None:

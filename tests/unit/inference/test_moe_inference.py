@@ -93,3 +93,28 @@ def test_moe_expert_sharding_at_load(eight_devices):
     np.testing.assert_array_equal(out1, out2)
     w1 = e2.params["layers_1"]["moe_experts"]["w1"]
     assert "expert" in str(w1.sharding.spec)
+
+
+def test_chunked_moe_prefill_matches_unchunked(monkeypatch):
+    """Chunked token routing (memory-linear prefill) is exactly whole-sequence routing."""
+    from deepspeed_tpu.models.causal_lm import CausalLM, CausalLMLayer, gpt2_cfg
+    cfg = gpt2_cfg(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+                   num_experts=4, moe_layer_interval=2, dtype=jnp.float32)
+    module = CausalLM(cfg)
+    ids = np.random.default_rng(3).integers(0, 96, size=(2, 24)).astype(np.int32)
+    params = module.init({"params": jax.random.PRNGKey(0)}, jnp.asarray(ids))["params"]
+    big = module.apply({"params": params}, jnp.asarray(ids))       # one chunk (48 <= 256)
+    monkeypatch.setattr(CausalLMLayer, "MOE_CHUNK", 8)             # force 6 chunks
+    small = module.apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(big), np.asarray(small), rtol=2e-5, atol=2e-5)
+
+
+def test_generate_zero_tokens():
+    from deepspeed_tpu.models.causal_lm import gpt2_cfg
+    cfg = gpt2_cfg(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=1, n_head=4,
+                   dtype=jnp.float32)
+    engine = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    ids = np.zeros((1, 5), dtype=np.int32)
+    out = engine.generate(ids, max_new_tokens=0)
+    assert out.shape == (1, 5)

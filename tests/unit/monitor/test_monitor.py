@@ -1,0 +1,63 @@
+"""Monitor tests — reference ``tests/unit/monitor/test_monitor.py``."""
+
+import csv
+import os
+
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.config.config import MonitorConfig
+from deepspeed_tpu.monitor import MonitorMaster, csvMonitor
+
+
+def test_csv_monitor_writes_events(tmp_path):
+    cfg = MonitorConfig(csv_monitor={"enabled": True, "output_path": str(tmp_path),
+                                     "job_name": "job"})
+    mon = csvMonitor(cfg.csv_monitor)
+    assert mon.enabled
+    mon.write_events([("Train/loss", 1.5, 1), ("Train/loss", 1.25, 2),
+                      ("Train/lr", 0.1, 1)])
+    mon.close()
+    loss_file = os.path.join(str(tmp_path), "job", "Train_loss.csv")
+    with open(loss_file) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["step", "value"]
+    assert rows[1] == ["1", "1.5"] and rows[2] == ["2", "1.25"]
+    assert os.path.exists(os.path.join(str(tmp_path), "job", "Train_lr.csv"))
+
+
+def test_monitor_master_dispatch(tmp_path):
+    cfg = MonitorConfig(csv_monitor={"enabled": True, "output_path": str(tmp_path),
+                                     "job_name": "m"})
+    master = MonitorMaster(cfg)
+    assert master.enabled
+    master.write_events([("a/b", 3.0, 7)])
+    with open(os.path.join(str(tmp_path), "m", "a_b.csv")) as f:
+        assert "7,3.0" in f.read()
+
+
+def test_disabled_monitor_noop():
+    master = MonitorMaster(MonitorConfig())
+    assert not master.enabled
+    master.write_events([("x", 1.0, 1)])  # must not raise
+
+
+def test_engine_writes_monitor_events(tmp_path):
+    """Training with csv monitor enabled produces real event files (the round-1 phantom:
+    config parsed, nothing written)."""
+    from deepspeed_tpu.models import GPT2Config, gpt2_model
+    model = gpt2_model(GPT2Config(vocab_size=64, n_positions=32, n_embd=32, n_layer=1,
+                                  n_head=2, dropout=0.0), sample_seq_len=16)
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path), "job_name": "t"},
+    })
+    batch = {"input_ids": np.zeros((8, 16), dtype=np.int32)}
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    loss_csv = os.path.join(str(tmp_path), "t", "Train_Samples_train_loss.csv")
+    assert os.path.exists(loss_csv)
+    with open(loss_csv) as f:
+        assert len(f.readlines()) >= 3  # header + 2 steps
