@@ -1,0 +1,2 @@
+from .cpu_adam import DeepSpeedCPUAdam, adam_step, native_available
+from .fused_adam import fused_adam, fused_adamw

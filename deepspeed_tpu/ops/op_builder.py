@@ -1,0 +1,91 @@
+"""JIT builder for host-side native (C++) ops.
+
+The TPU analogue of the reference's ``op_builder/builder.py`` (``OpBuilder.jit_load:430``):
+device kernels need no builder here (XLA/Pallas compile in-process), but the host tier —
+SIMD optimizer steps for ZeRO-Offload, async file I/O for the NVMe swap — is C++ just like
+the reference's ``csrc/``. Sources live in ``deepspeed_tpu/ops/csrc/`` and are compiled on
+first use into a content-hashed shared library under ``~/.cache/deepspeed_tpu/ops`` (override
+with ``DS_TPU_BUILD_DIR``), then loaded via ctypes.
+
+Flag fallback chain mirrors the reference's CPU-arch probing (``builder.py:cpu_arch``):
+``-march=native -fopenmp`` → ``-fopenmp`` → plain ``-O3``.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Sequence
+
+from ..utils.logging import logger
+
+CSRC_DIR = os.path.join(os.path.dirname(__file__), "csrc")
+
+_loaded: Dict[str, ctypes.CDLL] = {}
+_lock = threading.Lock()
+
+
+class OpBuildError(RuntimeError):
+    pass
+
+
+def _build_dir() -> str:
+    d = os.environ.get("DS_TPU_BUILD_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile(sources: Sequence[str], out_path: str, extra_flags: Sequence[str]):
+    flag_sets = (
+        ["-O3", "-march=native", "-fopenmp"],
+        ["-O3", "-fopenmp"],
+        ["-O3"],
+    )
+    last_err = None
+    tmp = out_path + ".tmp"
+    for flags in flag_sets:
+        cmd = (["g++", "-shared", "-fPIC", "-std=c++17"] + list(flags) +
+               list(extra_flags) + list(sources) + ["-o", tmp])
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise OpBuildError(f"g++ unavailable or timed out: {e}")
+        if proc.returncode == 0:
+            os.replace(tmp, out_path)
+            logger.info(f"[op_builder] built {os.path.basename(out_path)} "
+                        f"({' '.join(flags)})")
+            return
+        last_err = proc.stderr
+    raise OpBuildError(f"native build failed for {sources}:\n{last_err}")
+
+
+def load_op(name: str, sources: Sequence[str],
+            extra_flags: Sequence[str] = ()) -> ctypes.CDLL:
+    """Compile (cached) and dlopen a csrc op. ``sources`` are csrc-relative paths."""
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        paths = [os.path.join(CSRC_DIR, s) for s in sources]
+        h = hashlib.sha256()
+        for p in paths:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(extra_flags).encode())
+        so = os.path.join(_build_dir(), f"{name}-{h.hexdigest()[:12]}.so")
+        if not os.path.exists(so):
+            _compile(paths, so, extra_flags)
+        lib = ctypes.CDLL(so)
+        _loaded[name] = lib
+        return lib
+
+
+def op_available(name: str, sources: Sequence[str]) -> bool:
+    """Probe-compile (the reference's ``is_compatible`` check)."""
+    try:
+        load_op(name, sources)
+        return True
+    except OpBuildError as e:
+        logger.warning(f"[op_builder] {name} unavailable: {e}")
+        return False

@@ -92,6 +92,17 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.float32
         self.loss_scaler, scaler_state0 = create_loss_scaler(self._config.fp16)
 
+        # ---- ZeRO-Offload gate (reference stage_1_and_2.py:130 cpu_offload) -----
+        off_cfg = self._config.zero_config.offload_optimizer
+        self.offload_enabled = bool(off_cfg is not None and
+                                    off_cfg.device not in (None, "none"))
+        self._offload_tier = None
+        if self.offload_enabled and dist.get_world_size() > 1:
+            raise NotImplementedError(
+                "offload_optimizer currently supports single-host topologies "
+                "(all grads addressable from the controller); multi-host pods "
+                "would need per-process partition updates")
+
         # ---- optimizer (reference _configure_optimizer:1261) --------------------
         self.optimizer = self._configure_optimizer(optimizer)
         self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -129,33 +140,55 @@ class DeepSpeedEngine:
             ranks=[0])
 
     # ------------------------------------------------------------------ config
-    def _configure_optimizer(self, optimizer) -> Optimizer:
+    def _parse_optimizer_config(self) -> Dict[str, Any]:
+        """Normalised optimizer hyperparams from the config block (shared by the in-graph
+        and the host-offloaded paths)."""
+        name = self._config.optimizer_name or "adam"
+        params = dict(self._config.optimizer_params)
+        self._base_lr = params.pop("lr", 1e-3)
+        out = {
+            "name": name,
+            "betas": tuple(params.pop("betas", (0.9, 0.999))),
+            "eps": params.pop("eps", 1e-10 if name == "adagrad" else 1e-8),
+            "weight_decay": params.pop("weight_decay", 0.0),
+            # torch-style flag accepted in reference adam params
+            "adam_w_mode": params.pop("adam_w_mode", name == "adamw") or name == "adamw",
+            "bias_correction": params.pop("bias_correction", True),
+            "max_coeff": params.pop("max_coeff", 10.0),
+            "min_coeff": params.pop("min_coeff", 0.01),
+        }
+        params.pop("torch_adam", None)
+        return out
+
+    def _configure_optimizer(self, optimizer) -> Optional[Optimizer]:
         if optimizer is not None:
+            if self.offload_enabled:
+                raise ValueError(
+                    "zero_optimization.offload_optimizer requires a config-declared "
+                    "optimizer (adam/adamw/adagrad), not a user optimizer object")
             if isinstance(optimizer, Optimizer):
                 return optimizer
             if hasattr(optimizer, "init") and hasattr(optimizer, "update"):
                 return from_optax(optimizer)
             raise TypeError(f"Unsupported optimizer object: {optimizer!r}")
-        name = self._config.optimizer_name or "adam"
-        params = dict(self._config.optimizer_params)
-        self._base_lr = params.pop("lr", 1e-3)
-        betas = tuple(params.pop("betas", (0.9, 0.999)))
-        eps = params.pop("eps", 1e-8)
-        wd = params.pop("weight_decay", 0.0)
-        # torch-style flag accepted in reference adam params
-        adam_w_mode = params.pop("adam_w_mode", name == "adamw")
-        params.pop("torch_adam", None)
-        bias_correction = params.pop("bias_correction", True)
+        oc = self._parse_optimizer_config()
+        name = oc["name"]
+        if self.offload_enabled:
+            if name not in ("adam", "adamw", "fusedadam", "adagrad"):
+                raise ValueError(f"offload_optimizer supports adam/adamw/adagrad, "
+                                 f"got {name!r}")
+            return None  # host tier built in _build_state; no in-graph opt state
         if name in ("adam", "adamw", "fusedadam"):
-            return fused_adam(betas=betas, eps=eps, weight_decay=wd,
-                              adam_w_mode=adam_w_mode or name == "adamw",
-                              bias_correction=bias_correction)
+            return fused_adam(betas=oc["betas"], eps=oc["eps"],
+                              weight_decay=oc["weight_decay"],
+                              adam_w_mode=oc["adam_w_mode"],
+                              bias_correction=oc["bias_correction"])
         if name in ("lamb", "fusedlamb"):
-            return fused_lamb(betas=betas, eps=eps, weight_decay=wd,
-                              max_coeff=params.pop("max_coeff", 10.0),
-                              min_coeff=params.pop("min_coeff", 0.01))
+            return fused_lamb(betas=oc["betas"], eps=oc["eps"],
+                              weight_decay=oc["weight_decay"],
+                              max_coeff=oc["max_coeff"], min_coeff=oc["min_coeff"])
         if name == "adagrad":
-            return adagrad(eps=params.pop("eps", 1e-10), weight_decay=wd)
+            return adagrad(eps=oc["eps"], weight_decay=oc["weight_decay"])
         raise ValueError(f"Unknown optimizer {name!r} "
                          f"(supported: adam, adamw, lamb, adagrad, or pass an Optimizer)")
 
@@ -205,13 +238,27 @@ class DeepSpeedEngine:
         params = jax.jit(self.module.init_fn,
                          out_shardings=self._param_shardings)(rng)
 
-        abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
-        self._opt_spec_tree = optimizer_state_specs(
-            abstract_opt, mesh, self.zero_stage,
-            abstract_params=abstract_params, param_spec_tree=self._param_spec_tree)
-        self._opt_shardings = to_shardings(self._opt_spec_tree, mesh)
-        opt_state = jax.jit(self.optimizer.init,
-                            out_shardings=self._opt_shardings)(params)
+        if self.offload_enabled:
+            # Host tier owns fp32 masters + moments; HBM keeps only compute-dtype params.
+            from .zero.offload import OffloadOptimizerTier
+            oc = self._parse_optimizer_config()
+            kind = "adagrad" if oc["name"] == "adagrad" else "adam"
+            self._offload_tier = OffloadOptimizerTier(
+                params, self._param_shardings, self.compute_dtype, kind=kind,
+                betas=oc["betas"], eps=oc["eps"], weight_decay=oc["weight_decay"],
+                adam_w_mode=oc["adam_w_mode"], bias_correction=oc["bias_correction"])
+            del params
+            params = self._offload_tier.initial_device_params()
+            opt_state = ()
+            self._opt_shardings = ()
+        else:
+            abstract_opt = jax.eval_shape(self.optimizer.init, abstract_params)
+            self._opt_spec_tree = optimizer_state_specs(
+                abstract_opt, mesh, self.zero_stage,
+                abstract_params=abstract_params, param_spec_tree=self._param_spec_tree)
+            self._opt_shardings = to_shardings(self._opt_spec_tree, mesh)
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=self._opt_shardings)(params)
 
         self._grad_spec_tree = grad_accum_specs(abstract_params, mesh, self.zero_stage,
                                                 param_base_specs=self.module.param_specs)
@@ -247,8 +294,9 @@ class DeepSpeedEngine:
         (scaled, loss), grads = jax.value_and_grad(f, has_aux=True)(params)
         return loss, grads
 
-    def _apply_update(self, state: TrainState, grads_acc, lr, n_micro):
-        """Unscale, clip, overflow-guard, optimizer update, scaler update."""
+    def _unscale_clip_and_check(self, state: TrainState, grads_acc, n_micro):
+        """Shared device-side tail of both update paths: unscale by loss-scale × n_micro,
+        prescale, global-norm overflow check, clip. Returns (grads, norm, overflow)."""
         scale = state.scaler.cur_scale
         grads = jax.tree_util.tree_map(
             lambda g: g / (scale * np.float32(n_micro)), grads_acc)
@@ -264,6 +312,12 @@ class DeepSpeedEngine:
         if clip and clip > 0:
             safe_norm = jnp.where(jnp.isfinite(norm), norm, 1.0)
             grads = clip_by_global_norm(grads, clip, norm=safe_norm)
+        return grads, norm, overflow
+
+    def _apply_update(self, state: TrainState, grads_acc, lr, n_micro):
+        """Unscale, clip, overflow-guard, optimizer update, scaler update."""
+        scale = state.scaler.cur_scale
+        grads, norm, overflow = self._unscale_clip_and_check(state, grads_acc, n_micro)
         new_params, new_opt = self.optimizer.update(grads, state.opt_state, state.params,
                                                     jnp.float32(lr))
         keep_old = lambda old, new: jnp.where(overflow, old, new)
@@ -280,12 +334,31 @@ class DeepSpeedEngine:
         metrics = {"grad_norm": norm, "overflow": overflow, "loss_scale": scale}
         return new_state, metrics
 
+    def _finalize_grads_offload(self, state: TrainState, grads_acc, n_micro):
+        """Offload-mode device-side tail: unscale, clip, overflow-check, scaler update.
+        The optimizer update itself happens on host (see ``zero/offload.py``)."""
+        scale = state.scaler.cur_scale
+        grads, norm, overflow = self._unscale_clip_and_check(state, grads_acc, n_micro)
+        new_scaler = self.loss_scaler.update(state.scaler, overflow)
+        new_state = state._replace(
+            scaler=new_scaler,
+            global_step=state.global_step + 1,
+            skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
+        # D2H transfer dtype: bf16 halves the bytes and keeps fp32's exponent range, so
+        # it is safe for unscaled grads; fp16's 5-bit exponent would flush exactly the
+        # small-gradient range loss scaling exists to protect, so fp16 runs ship fp32.
+        transfer_dtype = jnp.bfloat16 if self.compute_dtype == jnp.bfloat16 \
+            else jnp.float32
+        grads_out = tree_cast(grads, transfer_dtype)
+        metrics = {"grad_norm": norm, "overflow": overflow, "loss_scale": scale}
+        return new_state, grads_out, metrics
+
     def _build_train_step(self):
         """Fused whole-batch step: scan over gas microbatches, then update."""
         gas = self.gradient_accumulation_steps()
         grad_shardings = self._grad_shardings
 
-        def train_step(state: TrainState, batch, lr):
+        def accumulate(state: TrainState, batch):
             step_rng = jax.random.fold_in(self._base_rng, state.global_step)
 
             def micro(acc, xs):
@@ -299,7 +372,23 @@ class DeepSpeedEngine:
 
             acc0 = jax.lax.with_sharding_constraint(
                 tree_zeros_like(state.params, jnp.float32), grad_shardings)
-            acc, losses = jax.lax.scan(micro, acc0, (batch, jnp.arange(gas)))
+            return jax.lax.scan(micro, acc0, (batch, jnp.arange(gas)))
+
+        if self.offload_enabled:
+            def train_step_offload(state: TrainState, batch):
+                acc, losses = accumulate(state, batch)
+                new_state, grads_out, metrics = self._finalize_grads_offload(
+                    state, acc, gas)
+                metrics["loss"] = jnp.mean(losses)
+                return new_state, grads_out, metrics
+
+            self._fns["train_step"] = jax.jit(
+                train_step_offload, donate_argnums=(0,),
+                out_shardings=(self._state_shardings, self._grad_shardings, None))
+            return
+
+        def train_step(state: TrainState, batch, lr):
+            acc, losses = accumulate(state, batch)
             new_state, metrics = self._apply_update(state, acc, lr, gas)
             metrics["loss"] = jnp.mean(losses)
             return new_state, metrics
@@ -314,6 +403,9 @@ class DeepSpeedEngine:
 
         def fwd_bwd(params, scale, batch, rng):
             loss, grads = self._loss_and_scaled_grads(params, scale, batch, rng)
+            # fp32 accumulation regardless of param dtype (the fused path's acc0 is fp32;
+            # bf16/fp16 accumulation across microbatches would drop small contributions)
+            grads = tree_cast(grads, jnp.float32)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
             return loss, grads
 
@@ -322,12 +414,17 @@ class DeepSpeedEngine:
             lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g),
             donate_argnums=(0,), out_shardings=grad_shardings)
 
-        def apply_step(state, acc, lr, n_micro):
-            return self._apply_update(state, acc, lr, n_micro)
+        if self.offload_enabled:
+            self._fns["finalize_offload"] = jax.jit(
+                self._finalize_grads_offload, static_argnums=(2,), donate_argnums=(0,),
+                out_shardings=(self._state_shardings, self._grad_shardings, None))
+        else:
+            def apply_step(state, acc, lr, n_micro):
+                return self._apply_update(state, acc, lr, n_micro)
 
-        self._fns["apply_step"] = jax.jit(
-            apply_step, static_argnums=(3,), donate_argnums=(0,),
-            out_shardings=(self._state_shardings, None))
+            self._fns["apply_step"] = jax.jit(
+                apply_step, static_argnums=(3,), donate_argnums=(0,),
+                out_shardings=(self._state_shardings, None))
 
         def eval_step(params, batch, rng):
             loss = self.module.loss_fn(tree_cast(params, self.compute_dtype), batch, rng)
@@ -394,7 +491,11 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = np.float32(self.get_lr_value())
-        self.state, metrics = jitted(self.state, gbatch, lr)
+        if self.offload_enabled:
+            self.state, grads, metrics = jitted(self.state, gbatch)
+            self._host_optimizer_step(grads, lr, metrics)
+        else:
+            self.state, metrics = jitted(self.state, gbatch, lr)
         self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=True)
 
@@ -414,6 +515,15 @@ class DeepSpeedEngine:
                      ranks=[0])
         return metrics["loss"]
 
+    def _host_optimizer_step(self, grads, lr, metrics):
+        """Offload mode: host Adam on fp32 masters, push compute-dtype params H2D.
+        The overflow read only syncs under fp16 (the offload path is host-synchronous at
+        the grad fetch anyway)."""
+        skip = bool(metrics["overflow"]) if self._config.fp16.enabled else False
+        new_params = self._offload_tier.step(grads, lr=float(lr), skip=skip)
+        if new_params is not None:
+            self.state = self.state._replace(params=new_params)
+
     def _run_flops_profiler(self, gbatch):
         """One-shot train-step profile at ``flops_profiler.profile_step``
         (reference ``engine.py:1791-1800`` wiring)."""
@@ -423,6 +533,8 @@ class DeepSpeedEngine:
 
         def step_fn(state, batch):
             jitted = self._fns["train_step"]
+            if self.offload_enabled:
+                return jitted(state, batch)
             return jitted(state, batch, lr)
 
         try:
@@ -486,7 +598,7 @@ class DeepSpeedEngine:
 
         Reference ``engine.py:2143 step`` / ``_take_model_step:2075``.
         """
-        if "apply_step" not in self._fns:
+        if "fwd_bwd" not in self._fns:
             self._build_micro_fns()
         take_step = self.is_gradient_accumulation_boundary()
         self.micro_steps += 1
@@ -495,8 +607,13 @@ class DeepSpeedEngine:
         assert self._grad_acc is not None, "step() called with no accumulated gradients"
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = np.float32(self.get_lr_value())
-        self.state, metrics = self._fns["apply_step"](
-            self.state, self._grad_acc, lr, self.gradient_accumulation_steps())
+        if self.offload_enabled:
+            self.state, grads, metrics = self._fns["finalize_offload"](
+                self.state, self._grad_acc, self.gradient_accumulation_steps())
+            self._host_optimizer_step(grads, lr, metrics)
+        else:
+            self.state, metrics = self._fns["apply_step"](
+                self.state, self._grad_acc, lr, self.gradient_accumulation_steps())
         self._grad_acc = None
         self._host_steps += 1
         if self.lr_scheduler is not None:
@@ -581,6 +698,11 @@ class DeepSpeedEngine:
         self.checkpoint_engine.makedirs(path)
         self.checkpoint_engine.create(tag)
         self.checkpoint_engine.save(self.state._asdict(), os.path.join(path, "state"))
+        if self.offload_enabled:
+            # host-resident fp32 masters + moments (reference: offloaded optimizer
+            # partitions serialize through the same checkpoint, stage_1_and_2.py:2235)
+            self.checkpoint_engine.save(self._offload_tier.state_dict(),
+                                        os.path.join(path, "offload_state"))
         side = {
             "global_step": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -621,6 +743,22 @@ class DeepSpeedEngine:
             new_state = self.state._replace(params=new_state.params,
                                             global_step=new_state.global_step)
         self.state = new_state
+        if self.offload_enabled:
+            off_path = os.path.join(path, "offload_state")
+            if load_optimizer_states and not load_module_only \
+                    and os.path.isdir(off_path):
+                restored_off = self.checkpoint_engine.load(
+                    off_path, template=self._offload_tier.state_dict())
+                self._offload_tier.load_state_dict(restored_off)
+                # device params re-derive from the restored masters (they are the source
+                # of truth in offload mode)
+                self.state = self.state._replace(
+                    params=self._offload_tier.initial_device_params())
+            else:
+                # module-only / no-opt-state load (or a checkpoint written without the
+                # offload tier): masters MUST follow the loaded weights, else the next
+                # host step would overwrite them with stale init-time masters
+                self._offload_tier.reseed_from_device(self.state.params)
         self._host_steps = int(new_state.global_step)   # resync host mirror (one-off sync)
         side = self.checkpoint_engine.load(os.path.join(path, "client_state.pkl"))
         self.micro_steps = side.get("micro_steps", 0)
