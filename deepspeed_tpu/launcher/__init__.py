@@ -1,0 +1,2 @@
+"""Launcher package: multi-node runner + per-node spawner (reference deepspeed/launcher)."""
+from .runner import main as runner_main  # noqa: F401

@@ -1,0 +1,117 @@
+"""Per-node process spawner.
+
+TPU-native analogue of reference ``deepspeed/launcher/launch.py`` (``main:129``): given this
+node's rank and the world layout, spawn one Python process per local worker with the
+coordinator env contract that ``comm.init_distributed`` consumes
+(``COORDINATOR_ADDRESS``/``NPROC``/``PROCESS_ID``/``LOCAL_RANK``), forward SIGINT/SIGTERM to
+the children, and propagate the first failure (killing the stragglers) — the reference's
+sig_names/поll loop, minus CUDA_VISIBLE_DEVICES bookkeeping which has no TPU analogue (chips
+are assigned by the TPU runtime per process via ``TPU_PROCESS_BOUNDS``-style env, or shared
+under a single process).
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="deepspeed_tpu per-node launcher")
+    parser.add_argument("--node_rank", type=int, default=0,
+                        help="rank of this node in the job")
+    parser.add_argument("--num_nodes", type=int, default=1)
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="worker processes to spawn on this node")
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1",
+                        help="coordinator host (jax.distributed rendezvous)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--module", action="store_true",
+                        help="interpret the script as a python module (python -m)")
+    parser.add_argument("--no_python", action="store_true",
+                        help="exec the script directly, not via the python interpreter")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def build_cmd(args) -> List[str]:
+    if args.no_python:
+        cmd = [args.training_script]
+    elif args.module:
+        cmd = [sys.executable, "-u", "-m", args.training_script]
+    else:
+        cmd = [sys.executable, "-u", args.training_script]
+    return cmd + list(args.training_script_args)
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_size = args.num_nodes * args.nproc_per_node
+    cmd = build_cmd(args)
+
+    processes: List[subprocess.Popen] = []
+    for local_rank in range(args.nproc_per_node):
+        env = os.environ.copy()
+        env["COORDINATOR_ADDRESS"] = f"{args.master_addr}:{args.master_port}"
+        env["MASTER_ADDR"] = args.master_addr
+        env["MASTER_PORT"] = str(args.master_port)
+        env["NPROC"] = env["WORLD_SIZE"] = str(world_size)
+        env["PROCESS_ID"] = env["RANK"] = str(
+            args.node_rank * args.nproc_per_node + local_rank)
+        env["LOCAL_RANK"] = str(local_rank)
+        env["NODE_RANK"] = str(args.node_rank)
+        logger.info(f"[launch] node {args.node_rank} local {local_rank} -> "
+                    f"rank {env['RANK']}/{world_size}: {' '.join(cmd)}")
+        processes.append(subprocess.Popen(cmd, env=env))
+
+    def forward_signal(signum, frame):
+        for p in processes:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signum)
+                except OSError:
+                    pass
+
+    signal.signal(signal.SIGINT, forward_signal)
+    signal.signal(signal.SIGTERM, forward_signal)
+
+    # reference launch.py poll loop: first non-zero exit kills the rest, escalating
+    # terminate -> kill so a worker stuck in a collective (SIGTERM pending) can't hang us
+    exit_code = 0
+    kill_deadline = None
+    alive = list(processes)
+    while alive:
+        time.sleep(0.1)
+        if kill_deadline is not None and time.monotonic() > kill_deadline:
+            for q in alive:
+                try:
+                    q.kill()
+                except OSError:
+                    pass
+            kill_deadline = None
+        for p in list(alive):
+            rc = p.poll()
+            if rc is None:
+                continue
+            alive.remove(p)
+            if rc != 0 and exit_code == 0:
+                exit_code = rc
+                logger.error(f"[launch] rank process {p.args!r} failed with {rc}; "
+                             "terminating remaining workers")
+                kill_deadline = time.monotonic() + 15.0
+                for q in alive:
+                    try:
+                        q.terminate()
+                    except OSError:
+                        pass
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

@@ -165,10 +165,13 @@ class DeepSpeedCPUAdam:
 
 
 def _as_flat_f32_view(a: np.ndarray) -> np.ndarray:
-    """Flat fp32 view sharing memory when possible (so in-place updates propagate)."""
+    """Flat fp32 view sharing memory (in-place updates must propagate to the caller —
+    a silent copy would make ``step`` a no-op on the caller's buffer, so reject inputs
+    that would force one)."""
     a = np.asarray(a)
-    if a.dtype != np.float32:
-        a = a.astype(np.float32)
-    if not a.flags["C_CONTIGUOUS"]:
-        a = np.ascontiguousarray(a)
+    if a.dtype != np.float32 or not a.flags["C_CONTIGUOUS"] or not a.flags["WRITEABLE"]:
+        raise ValueError(
+            "DeepSpeedCPUAdam params must be writable C-contiguous fp32 arrays "
+            f"(got dtype={a.dtype}, contiguous={a.flags['C_CONTIGUOUS']}, "
+            f"writeable={a.flags['WRITEABLE']}); updates are in place")
     return a.reshape(-1)

@@ -44,7 +44,7 @@ def _compile(sources: Sequence[str], out_path: str, extra_flags: Sequence[str]):
         ["-O3"],
     )
     last_err = None
-    tmp = out_path + ".tmp"
+    tmp = f"{out_path}.{os.getpid()}.tmp"  # per-process: concurrent builds must not race
     for flags in flag_sets:
         cmd = (["g++", "-shared", "-fPIC", "-std=c++17"] + list(flags) +
                list(extra_flags) + list(sources) + ["-o", tmp])
@@ -76,7 +76,10 @@ def load_op(name: str, sources: Sequence[str],
         so = os.path.join(_build_dir(), f"{name}-{h.hexdigest()[:12]}.so")
         if not os.path.exists(so):
             _compile(paths, so, extra_flags)
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            raise OpBuildError(f"built {so} but dlopen failed: {e}")
         _loaded[name] = lib
         return lib
 

@@ -142,7 +142,10 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ config
     def _parse_optimizer_config(self) -> Dict[str, Any]:
         """Normalised optimizer hyperparams from the config block (shared by the in-graph
-        and the host-offloaded paths)."""
+        and the host-offloaded paths); parsed once and cached."""
+        cached = getattr(self, "_opt_cfg_cache", None)
+        if cached is not None:
+            return cached
         name = self._config.optimizer_name or "adam"
         params = dict(self._config.optimizer_params)
         self._base_lr = params.pop("lr", 1e-3)
@@ -158,6 +161,7 @@ class DeepSpeedEngine:
             "min_coeff": params.pop("min_coeff", 0.01),
         }
         params.pop("torch_adam", None)
+        self._opt_cfg_cache = out
         return out
 
     def _configure_optimizer(self, optimizer) -> Optional[Optimizer]:

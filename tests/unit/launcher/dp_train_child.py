@@ -1,0 +1,50 @@
+"""Child script for the launcher integration test: one DP train step across processes.
+
+Launched by ``deepspeed_tpu.launcher.runner --launcher local --num_procs 2``; each process
+contributes half the global batch, the engine trains over the cross-process mesh (Gloo
+collectives on CPU), and both ranks write their loss for the test to compare.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.environ["DS_TPU_REPO"])
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from tests.unit.simple_model import base_config, simple_model  # noqa: E402
+
+HID = 16
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    model = simple_model(HID)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, config=base_config(batch_size=8, stage=0, lr=1e-2))
+    assert jax.process_count() == 2, jax.process_count()
+    assert engine.mesh_spec.dp_world_size == 2
+
+    rank = jax.process_index()
+    rng = np.random.default_rng(100 + rank)  # different data per rank
+    local = {"x": rng.standard_normal((4, HID)).astype(np.float32)}
+    local["y"] = local["x"] @ np.eye(HID, dtype=np.float32)
+    losses = [float(engine.train_batch(local)) for _ in range(2)]
+
+    with open(os.path.join(args.out, f"rank{rank}.txt"), "w") as f:
+        f.write(repr(losses))
+
+
+if __name__ == "__main__":
+    main()
