@@ -97,7 +97,7 @@ def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
         elif part.isdigit() and out:
             last = next(reversed(out))
             if out[last] is not None:
-                out[last].append(int(part))
+                out[last] = sorted(set(out[last] + [int(part)]))
         else:
             out[part] = None
     return out
@@ -136,6 +136,16 @@ def filter_resources(resource_pool: "OrderedDict[str, int]",
                 out[host] = n
         return out
     return OrderedDict(resource_pool)
+
+
+def _is_local_host(host: str) -> bool:
+    import socket
+    if host in ("localhost", "127.0.0.1", "::1"):
+        return True
+    try:
+        return host in (socket.gethostname(), socket.getfqdn())
+    except OSError:
+        return False
 
 
 # --------------------------------------------------------------------- tpu pod env
@@ -238,7 +248,10 @@ def main(argv=None) -> int:
         else:
             pool = filter_resources(parse_hostfile(args.hostfile),
                                     args.include, args.exclude)
-            launcher = "ssh" if (len(pool) > 1 or args.force_multi) else "local"
+            # a single host still means ssh when it isn't THIS machine
+            remote_single = len(pool) == 1 and not _is_local_host(next(iter(pool)))
+            launcher = "ssh" if (len(pool) > 1 or remote_single or
+                                 args.force_multi) else "local"
 
     if launcher == "tpu-pod":
         if pod is None:

@@ -132,6 +132,34 @@ def _as_pipe_layer(obj) -> PipeLayer:
     raise TypeError(f"Cannot adapt {obj!r} to a pipeline layer")
 
 
+def _split_batch(batch):
+    """(inputs, labels) from the accepted batch forms — shared by every pipeline path."""
+    if isinstance(batch, (tuple, list)) and len(batch) == 2:
+        return batch[0], batch[1]
+    if isinstance(batch, dict):
+        return batch["inputs"], batch.get("labels")
+    return batch, None
+
+
+def partition_weights(layers: Sequence, abstract_params: Sequence,
+                      method: str) -> List[float]:
+    """Per-layer weights for stage balancing (reference ``module.py:_partition_layers``
+    methods): ``uniform``, ``parameters``, or ``type:<regex>``. Shared by
+    :class:`PipelineModule` and the eager executor."""
+    method = method.lower()
+    if method == "uniform":
+        return [1.0] * len(layers)
+    if method == "parameters":
+        return [float(sum(int(np.prod(l.shape))
+                          for l in jax.tree_util.tree_leaves(p))) or 1.0
+                for p in abstract_params]
+    if method.startswith("type:"):
+        pat = re.compile(method[len("type:"):], re.IGNORECASE)
+        return [1.0 if pat.search(type(layer).__name__) else 0.0
+                for layer in layers]
+    raise NotImplementedError(f"partition_method {method!r}")
+
+
 # --------------------------------------------------------------------------- partitioning
 def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
     """Split ``weights`` into ``num_parts`` contiguous parts minimising the heaviest part.
@@ -280,20 +308,8 @@ class PipelineModule:
     def _compute_parts(self) -> List[int]:
         """Stage boundaries over the full layer list (reference ``_partition_layers:367``) —
         informational/ckpt-naming; the SPMD executor uses the body stacking above."""
-        method = self.partition_method.lower()
-        n = len(self._layers)
-        if method == "uniform":
-            weights = [1.0] * n
-        elif method == "parameters":
-            weights = [float(sum(int(np.prod(l.shape))
-                                 for l in jax.tree_util.tree_leaves(p)))
-                       for p in self._abstract_params]
-        elif method.startswith("type:"):
-            pat = re.compile(method[len("type:"):], re.IGNORECASE)
-            weights = [1.0 if pat.search(type(layer).__name__) else 0.0
-                       for layer in self._layers]
-        else:
-            raise NotImplementedError(f"partition_method {self.partition_method!r}")
+        weights = partition_weights(self._layers, self._abstract_params,
+                                    self.partition_method)
         return partition_balanced(weights, self.num_stages)
 
     # ------------------------------------------------------------------ params
@@ -443,24 +459,280 @@ class PipelineModule:
         stacked = mapped(params["body"], xs, rng)  # (S, M, mb, ...)
         return stacked[S - 1]
 
+    # ------------------------------------------------------------------ 1F1B
+    def make_1f1b_loss_fn(self, mesh_spec: Optional[MeshSpec] = None):
+        """Interleaved 1F1B with manual in-loop backward — O(stages) activation memory.
+
+        Reference semantics: ``runtime/pipe/engine.py:295`` executing
+        ``schedule.py:TrainSchedule`` (warmup forwards, steady-state one-forward-one-
+        backward, drain). The SPMD realisation runs one lockstep ``lax.scan`` over
+        ``2(M+S)-3`` ticks; at tick ``t`` stage ``s`` forwards microbatch ``(t-s)/2`` and
+        backwards microbatch ``(t-(2S-2-s))/2`` (both when valid — steady-state ticks do
+        one of each, the 1F1B alternation). Activations cross stages by ``ppermute``;
+        cotangents ride the reverse permute one tick behind.
+
+        Unlike the GPipe path (autodiff through the fill-drain loop, which stores an
+        O(M) boundary-activation residual per stage), gradients here are computed *inside*
+        the loop: each stage keeps a circular stash of its last ``S`` microbatch inputs and,
+        on a backward tick, re-plays its block run under ``jax.vjp`` (per-microbatch remat
+        — the 2× forward cost every 1F1B implementation pays via activation checkpointing)
+        and folds parameter cotangents into fp32 accumulators carried by the scan. Nothing
+        autodiffs *through* the scan, so peak activation memory is the stash — O(S·mb),
+        flat in M (verified by ``test_1f1b_memory_flat_in_microbatches``).
+
+        The pre segment (embeddings) runs on stage 0 *inside* its forward tick and the
+        post segment + loss on the last stage inside its tick, so no O(M) staging buffer
+        exists anywhere. Tied parameters may be consumed by both segments; their two
+        cotangent streams meet in the cross-stage ``psum`` (the reference's
+        ``ReduceTiedGrads``).
+
+        Returns ``fn(params, batch, rng) -> loss`` wrapped in ``jax.custom_vjp`` whose
+        forward pass also produces the full parameter gradient (the engine's
+        ``value_and_grad`` triggers exactly one loop execution).
+        """
+        S = self.num_stages
+        L_per = self.layers_per_stage
+        body_layer = self._layers[self.body_start]
+        n_layers = len(self._layers)
+
+        split_batch = _split_batch
+
+        def pre_apply(pre_p, tied_p, x, mrng):
+            view = {"pre": pre_p, "post": {}, "tied": tied_p}
+            return self._segment_apply(view, x, mrng, 0, self.body_start)
+
+        def tail_loss(post_p, tied_p, y, lab, mrng):
+            view = {"pre": {}, "post": post_p, "tied": tied_p}
+            out = self._segment_apply(view, y, mrng, self.body_end, n_layers)
+            if self.loss_fn is not None:
+                return self.loss_fn(out, lab)
+            return out if out.ndim == 0 else jnp.mean(out)
+
+        def stage_fn(stage_params, x, srng, use_rng):
+            def one(carry, xs_):
+                p, r = xs_
+                return body_layer.apply(p, carry, r if use_rng else None), None
+
+            rngs = jax.random.split(srng, L_per)
+            y, _ = jax.lax.scan(one, x, (stage_params, rngs))
+            return y
+
+        def idx(tree, m):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False), tree)
+
+        def tree_add(acc, new):
+            return jax.tree_util.tree_map(jnp.add, acc, new)
+
+        def f32_cast(tree):
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32), tree)
+
+        def f32_zeros(tree):
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+
+        def run_1f1b(params, batch, rng, use_rng: bool):
+            mesh = mesh_spec or _require_global_mesh()
+            inputs, labels = split_batch(batch)
+            M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+            n_ticks = 2 * (M + S) - 3
+            rng_pre = jax.random.fold_in(rng, 1)
+            rng_body = jax.random.fold_in(rng, 2)
+            rng_tail = jax.random.fold_in(rng, 3)
+
+            def run(body_p, pre_p, post_p, tied_p, inputs_, labels_):
+                s = jax.lax.axis_index(AXIS_PIPE)
+
+                # trace one pre output to size the activation stash
+                x0_shape = jax.eval_shape(
+                    pre_apply, _abstract(pre_p), _abstract(tied_p),
+                    _abstract(idx(inputs_, 0)), rng_pre)
+                stash0 = jnp.zeros((S,) + tuple(x0_shape.shape), x0_shape.dtype)
+
+                carry0 = dict(
+                    recv_f=jnp.zeros(x0_shape.shape, x0_shape.dtype),
+                    recv_b=jnp.zeros(x0_shape.shape, x0_shape.dtype),
+                    stash=stash0,
+                    loss=jnp.float32(0.0),
+                    dbody=f32_zeros(body_p),
+                    dpre=f32_zeros(pre_p),
+                    dpost=f32_zeros(post_p),
+                    dtied=f32_zeros(tied_p),
+                )
+
+                def tick(carry, t):
+                    # Every phase sits behind lax.cond on its validity predicate: for a
+                    # given stage, forward ticks (t-s even) and backward ticks
+                    # (t-(2S-2-s) even) share parity, so half of all ticks are no-ops —
+                    # cond (not jnp.where-after-compute) lets XLA skip them, and the
+                    # tail/pre VJPs additionally run only on the stage that keeps them.
+                    last = s == S - 1
+                    # ---------------- forward phase -----------------------------
+                    mf_raw = t - s
+                    is_f = (mf_raw >= 0) & (mf_raw % 2 == 0) & (mf_raw // 2 < M)
+                    mf = jnp.clip(mf_raw // 2, 0, M - 1)
+
+                    def fwd_block(stash_in, recv_f):
+                        x0 = pre_apply(
+                            pre_p, tied_p, idx(inputs_, mf),
+                            jax.random.fold_in(rng_pre, mf) if use_rng else None)
+                        x_in = jnp.where(s == 0, x0, recv_f)
+                        y = stage_fn(
+                            body_p, x_in,
+                            jax.random.fold_in(jax.random.fold_in(rng_body, mf), s),
+                            use_rng)
+                        return y, jax.lax.dynamic_update_index_in_dim(
+                            stash_in, x_in, mf % S, 0)
+
+                    def fwd_skip(stash_in, recv_f):
+                        return jnp.zeros_like(recv_f), stash_in
+
+                    y, stash = jax.lax.cond(is_f, fwd_block, fwd_skip,
+                                            carry["stash"], carry["recv_f"])
+
+                    def tail_block(y_):
+                        lab_m = idx(labels_, mf) if labels_ is not None else None
+                        loss_m, tail_vjp = jax.vjp(
+                            lambda po, ti, yy: tail_loss(
+                                po, ti, yy, lab_m,
+                                jax.random.fold_in(rng_tail, mf) if use_rng else None),
+                            post_p, tied_p, y_)
+                        dpost_m, dtied_m, dy_m = tail_vjp(jnp.float32(1.0))
+                        return (loss_m.astype(jnp.float32), f32_cast(dpost_m),
+                                f32_cast(dtied_m), dy_m.astype(y_.dtype))
+
+                    def tail_skip(y_):
+                        return (jnp.float32(0.0), f32_zeros(post_p),
+                                f32_zeros(tied_p), jnp.zeros_like(y_))
+
+                    loss_m, dpost_m, dtied_tail_m, dy_m = jax.lax.cond(
+                        is_f & last, tail_block, tail_skip, y)
+                    loss = carry["loss"] + loss_m
+                    dpost = tree_add(carry["dpost"], dpost_m)
+                    dtied = tree_add(carry["dtied"], dtied_tail_m)
+
+                    # ---------------- backward phase ----------------------------
+                    mb_raw = t - (2 * S - 2 - s)
+                    is_b = (mb_raw >= 0) & (mb_raw % 2 == 0) & (mb_raw // 2 < M)
+                    mb = jnp.clip(mb_raw // 2, 0, M - 1)
+                    cot = jnp.where(last, dy_m, carry["recv_b"])
+
+                    def bwd_block(stash_in, cot_):
+                        x_saved = jax.lax.dynamic_index_in_dim(stash_in, mb % S, 0,
+                                                               keepdims=False)
+                        _, svjp = jax.vjp(
+                            lambda bp, xx: stage_fn(
+                                bp, xx,
+                                jax.random.fold_in(jax.random.fold_in(rng_body, mb), s),
+                                use_rng),
+                            body_p, x_saved)
+                        dbody_m, dx = svjp(cot_)
+                        return f32_cast(dbody_m), dx.astype(cot_.dtype)
+
+                    def bwd_skip(stash_in, cot_):
+                        return f32_zeros(body_p), jnp.zeros_like(cot_)
+
+                    dbody_m, dx = jax.lax.cond(is_b, bwd_block, bwd_skip, stash, cot)
+                    dbody = tree_add(carry["dbody"], dbody_m)
+
+                    def pre_block(dx_):
+                        # stage 0 re-plays the pre segment to push dx into embeddings/tied
+                        _, pvjp = jax.vjp(
+                            lambda pr, ti: pre_apply(
+                                pr, ti, idx(inputs_, mb),
+                                jax.random.fold_in(rng_pre, mb) if use_rng else None),
+                            pre_p, tied_p)
+                        dpre_m, dtied_m = pvjp(dx_)
+                        return f32_cast(dpre_m), f32_cast(dtied_m)
+
+                    def pre_skip(dx_):
+                        return f32_zeros(pre_p), f32_zeros(tied_p)
+
+                    dpre_m, dtied_pre_m = jax.lax.cond(is_b & (s == 0),
+                                                       pre_block, pre_skip, dx)
+                    dpre = tree_add(carry["dpre"], dpre_m)
+                    dtied = tree_add(dtied, dtied_pre_m)
+
+                    new_carry = dict(
+                        recv_f=jax.lax.ppermute(
+                            y, AXIS_PIPE, [(i, i + 1) for i in range(S - 1)]),
+                        recv_b=jax.lax.ppermute(
+                            dx, AXIS_PIPE, [(i, i - 1) for i in range(1, S)]),
+                        stash=stash, loss=loss, dbody=dbody, dpre=dpre,
+                        dpost=dpost, dtied=dtied)
+                    return new_carry, None
+
+                out, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+                inv_m = jnp.float32(1.0 / M)
+                loss = jax.lax.psum(out["loss"] * inv_m, AXIS_PIPE)
+                scale_tree = lambda tr: jax.tree_util.tree_map(
+                    lambda g: g * inv_m, tr)
+                dpre = jax.lax.psum(scale_tree(out["dpre"]), AXIS_PIPE)
+                dpost = jax.lax.psum(scale_tree(out["dpost"]), AXIS_PIPE)
+                dtied = jax.lax.psum(scale_tree(out["dtied"]), AXIS_PIPE)
+                dbody = scale_tree(out["dbody"])
+                return loss, dbody, dpre, dpost, dtied
+
+            lab_spec = None if labels is None else P()
+            mapped = jax.shard_map(
+                run,
+                mesh=mesh.mesh,
+                axis_names={AXIS_PIPE},
+                in_specs=(P(AXIS_PIPE), P(), P(), P(), P(), lab_spec),
+                out_specs=(P(), P(AXIS_PIPE), P(), P(), P()),
+                check_vma=False,
+            )
+            loss, dbody, dpre, dpost, dtied = mapped(
+                params["body"], params["pre"], params["post"], params["tied"],
+                inputs, labels)
+            grads = {"body": dbody, "pre": dpre, "post": dpost, "tied": dtied}
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g.astype(p.dtype), grads,
+                {"body": params["body"], "pre": params["pre"],
+                 "post": params["post"], "tied": params["tied"]})
+            return loss, grads
+
+        @jax.custom_vjp
+        def pipe_loss(params, batch, rng):
+            loss, _ = run_1f1b(params, batch, rng, use_rng=True)
+            return loss
+
+        def pipe_loss_fwd(params, batch, rng):
+            loss, grads = run_1f1b(params, batch, rng, use_rng=True)
+            return loss, (grads, batch, rng)
+
+        def pipe_loss_bwd(res, g):
+            grads, batch, rng = res
+            dparams = jax.tree_util.tree_map(lambda x: (x * g).astype(x.dtype), grads)
+            return dparams, _zero_cotangent(batch), _zero_cotangent(rng)
+
+        pipe_loss.defvjp(pipe_loss_fwd, pipe_loss_bwd)
+        return pipe_loss
+
     # ------------------------------------------------------------------ model adapter
     def to_model(self, mesh_spec: Optional[MeshSpec] = None, name: str = "pipeline",
-                 remat: Optional[bool] = None):
+                 remat: Optional[bool] = None, schedule: str = "1f1b"):
         """Bundle into the engine's :class:`Model` contract. ``loss_fn`` consumes microbatched
         batches ``(inputs, labels)`` with leading dim M and returns mean loss; ``rng=None``
-        runs a deterministic (dropout-off) pass."""
+        runs a deterministic (dropout-off) pass.
+
+        ``schedule``: ``"1f1b"`` (default) trains through the interleaved
+        one-forward-one-backward loop with in-loop gradients — O(stages) activation
+        memory (see :meth:`make_1f1b_loss_fn`); ``"gpipe"`` trains by autodiff through
+        the fill-drain loop (O(microbatches) boundary residuals, no recompute). Eval
+        always uses the forward-only fill-drain pipeline.
+        """
         # imported here, not at module top: models/__init__ imports gpt2_pipe which imports
         # this module — a top-level import would make the cycle order-dependent
         from ...models.base import Model
         if remat is None:
             remat = self.activation_checkpoint_interval > 0
+        assert schedule in ("1f1b", "gpipe"), schedule
+        pipe_loss_1f1b = (self.make_1f1b_loss_fn(mesh_spec)
+                          if schedule == "1f1b" and self.num_stages > 1 else None)
 
-        def split_batch(batch):
-            if isinstance(batch, (tuple, list)) and len(batch) == 2:
-                return batch[0], batch[1]
-            if isinstance(batch, dict):
-                return batch["inputs"], batch.get("labels")
-            return batch, None
+        split_batch = _split_batch
 
         def loss_fn(params, batch, rng):
             mesh = mesh_spec or _require_global_mesh()
@@ -480,6 +752,9 @@ class PipelineModule:
                     return out if out.ndim == 0 else jnp.mean(out)
 
                 return jnp.mean(jax.vmap(tail_det)(ys, labels))
+
+            if pipe_loss_1f1b is not None:
+                return pipe_loss_1f1b(params, batch, rng)
 
             pre_rngs = jax.random.split(jax.random.fold_in(rng, 1), M)
             xs = jax.vmap(
@@ -512,6 +787,16 @@ class PipelineModule:
 def _abstract(p):
     return jax.tree_util.tree_map(
         lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), p)
+
+
+def _zero_cotangent(tree):
+    """Zero cotangents for a possibly-integer pytree (custom_vjp bwd for nondiff inputs):
+    float leaves get zeros, integer leaves (tokens, PRNG keys) get float0."""
+    def one(x):
+        if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+            return jnp.zeros_like(x)
+        return np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+    return jax.tree_util.tree_map(one, tree)
 
 
 def _require_global_mesh() -> MeshSpec:

