@@ -1,0 +1,7 @@
+"""Elastic training (reference ``deepspeed/elasticity``): batch-size/device-count
+co-design so jobs scale across a precomputed set of world sizes without convergence
+impact."""
+from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize)
+from .elasticity import (compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config)

@@ -1,0 +1,64 @@
+"""Elasticity configuration.
+
+Behavioural equivalent of reference ``deepspeed/elasticity/config.py``
+(``ElasticityConfig:27``): same JSON keys under ``"elasticity"``; "gpus" in key names kept
+for config compatibility but meaning *device counts* (TPU chips) here.
+"""
+
+from typing import List, Optional
+
+from pydantic import Field, field_validator
+
+from ..config.config_utils import ConfigModel
+
+
+class ElasticityError(Exception):
+    """Base elasticity error (reference ``config.py:9``)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Invalid elastic config (reference ``config.py:15``)."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """Current world size is not among the computed valid counts (reference
+    ``config.py:21``)."""
+
+
+class ElasticityConfig(ConfigModel):
+    """Reference keys (``elasticity/constants.py``)::
+
+        "elasticity": {
+          "enabled": true,
+          "max_train_batch_size": 2000,
+          "micro_batch_sizes": [2, 4, 6],
+          "min_gpus": 1, "max_gpus": 10000,
+          "min_time": 20,
+          "prefer_larger_batch": true,
+          "ignore_non_elastic_batch_info": false,
+          "version": 0.1  # 0.2 adds node-granular scheduling + model parallelism
+        }
+    """
+    enabled: bool = False
+    max_train_batch_size: int = Field(2000, gt=0)
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = Field(1, gt=0)
+    max_gpus: int = Field(10000, gt=0)
+    min_time: int = Field(0, ge=0)
+    version: float = 0.1
+    prefer_larger_batch: bool = Field(True, alias="prefer_larger_batch_size")
+    ignore_non_elastic_batch_info: bool = False
+    num_gpus_per_node: int = Field(1, gt=0)
+    model_parallel_size: int = Field(1, gt=0)
+
+    @field_validator("micro_batch_sizes")
+    @classmethod
+    def _positive_micro_batches(cls, v):
+        if not v or not all(isinstance(m, int) and m > 0 for m in v):
+            raise ValueError(
+                f"micro_batch_sizes must be a non-empty list of positive ints, got {v}")
+        return v
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"

@@ -1,0 +1,237 @@
+"""Elastic batch-size / device-count computation.
+
+Behavioural equivalent of reference ``deepspeed/elasticity/elasticity.py``
+(``compute_elastic_config:287``, ``_get_compatible_gpus_v01:125``, ``_get_compatible_gpus_v02:173``):
+given micro-batch candidates and a max acceptable global batch, pick the global batch size
+compatible with the most device counts, so a job can scale up/down across that set without
+changing convergence (batch = micro × gas × world). The math is framework-neutral; "gpus" in
+the public names is kept for API compatibility and means TPU chips here (v0.2's node
+granularity maps to TPU hosts — ``num_gpus_per_node`` ≡ chips per host).
+"""
+
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+from .config import (DEEPSPEED_ELASTICITY_CONFIG, ElasticityConfig,
+                     ElasticityConfigError, ElasticityError,
+                     ElasticityIncompatibleWorldSize, LATEST_ELASTICITY_VERSION)
+
+# Thirty-eight smallest highly composite numbers — enough for batch sizes up to ~720k
+# (reference elasticity.py:19 HCN_LIST).
+HCN_LIST = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680,
+            2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440,
+            83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280,
+            720720]
+
+
+def get_candidate_batch_sizes(base_list: List[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """Scale each base by the largest HCN keeping the product ≤ max (reference :61)."""
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+            continue
+        limit = max_acceptable_batch_size // base
+        scale = max(h for h in HCN_LIST if h <= limit)
+        candidates.add(scale * base)
+    out = sorted(candidates)
+    logger.info(f"Candidate batch sizes: {out}")
+    return out
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """All device counts w for which batch_size = micro × gas × w for some micro/gas
+    (reference :75): every divisor of batch_size//micro within [min, max]."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro != 0:
+            continue
+        max_devs = batch_size // micro
+        for w in range(1, int(math.isqrt(max_devs)) + 1):
+            if max_devs % w == 0:
+                for cand in (w, max_devs // w):
+                    if min_valid_gpus <= cand <= max_valid_gpus:
+                        valid.add(cand)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[int],
+                        min_gpus: int, max_gpus: int,
+                        prefer_larger: bool) -> Tuple[int, List[int]]:
+    """Pick the candidate with the most valid device counts; ties break toward the
+    larger (or smaller) batch (reference :97)."""
+    best_count = 0
+    best_valid: Optional[List[int]] = None
+    best_batch = int(min(micro_batches))
+    for batch_size in candidate_batch_sizes:
+        valid = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        better_tie = (len(valid) == best_count and
+                      ((prefer_larger and batch_size > best_batch) or
+                       (not prefer_larger and batch_size < best_batch)))
+        if len(valid) > best_count or better_tie:
+            best_count = len(valid)
+            best_valid = valid
+            best_batch = batch_size
+    return best_batch, best_valid
+
+
+def _get_compatible_gpus_v01(micro_batches: List[int],
+                             max_acceptable_batch_size: int,
+                             min_gpus: Optional[int] = None,
+                             max_gpus: Optional[int] = None,
+                             prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    """v0.1 heuristic (reference :125): bases = micro batches + their LCM, scaled by
+    HCNs; best candidate by compatible-device-count."""
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(
+            f"All micro batches {micro_batches} must be <= "
+            f"max_acceptable_batch_size {max_acceptable_batch_size}")
+    lcm = int(np.lcm.reduce(micro_batches))
+    base_list = list(micro_batches) + [lcm]
+    candidates = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus,
+                               prefer_larger)
+
+
+def _get_compatible_gpus_v02(micro_batches: List[int],
+                             max_acceptable_batch_size: int,
+                             current_num_gpus: int,
+                             min_gpus: int, max_gpus: int,
+                             prefer_larger: bool,
+                             num_gpus_per_node: int,
+                             model_parallel_size: int):
+    """v0.2 (reference :173): node-granular — each host contributes
+    ``chips_per_host // model_parallel_size`` data-parallel ranks."""
+    if num_gpus_per_node % model_parallel_size != 0:
+        raise ElasticityError(
+            f"v0.2: chips per host ({num_gpus_per_node}) must be divisible by "
+            f"model parallel size ({model_parallel_size})")
+
+    def get_microbatch(final_batch_size):
+        candidate = None
+        for micro in micro_batches:
+            if (final_batch_size // current_num_gpus) % micro == 0:
+                if candidate is None or (prefer_larger and micro > candidate):
+                    candidate = micro
+        return candidate
+
+    dp_size_per_node = num_gpus_per_node // model_parallel_size
+    final_batch_size, valid_nodes = _get_compatible_gpus_v01(
+        micro_batches,
+        int(max_acceptable_batch_size / dp_size_per_node),
+        int(min_gpus / num_gpus_per_node) or 1,
+        max(int(max_gpus / num_gpus_per_node), 1),
+        prefer_larger=prefer_larger)
+    final_batch_size = int(final_batch_size) * dp_size_per_node
+    valid_dp_sizes = [n * dp_size_per_node for n in valid_nodes]
+    if current_num_gpus // model_parallel_size in valid_dp_sizes:
+        return final_batch_size, valid_dp_sizes, get_microbatch(final_batch_size)
+
+    # current world size not in the elastic set: fall back to the largest batch
+    # reachable at this exact size (reference :214)
+    current_dp_size = (current_num_gpus / num_gpus_per_node) * dp_size_per_node
+    candidates = []
+    for micro in micro_batches:
+        min_batch = micro * current_dp_size
+        candidates.append(math.floor(max_acceptable_batch_size / min_batch) * min_batch)
+    batch = max(candidates) if prefer_larger else min(candidates)
+    return int(batch), [int(current_dp_size)], get_microbatch(int(batch))
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    """Reference :248."""
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict):
+    """Scheduler-fixed elastic config must not be changed by the runtime
+    (reference :254): compare against the env-propagated copy."""
+    import json
+    env_config = os.environ.get(DEEPSPEED_ELASTICITY_CONFIG)
+    if env_config is None:
+        return
+    scheduler_config = ElasticityConfig(**json.loads(env_config))
+    runtime_config = ElasticityConfig(**runtime_elastic_config_dict)
+    err = ("Elastic config '{}' seen by the runtime ({}) does not match the "
+           "scheduler-fixed value ({})")
+    for field in ("max_train_batch_size", "micro_batch_sizes", "min_gpus", "max_gpus",
+                  "version"):
+        if getattr(scheduler_config, field) != getattr(runtime_config, field):
+            raise ElasticityConfigError(
+                err.format(field, getattr(runtime_config, field),
+                           getattr(scheduler_config, field)))
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Reference ``compute_elastic_config:287``: deterministic
+    ``(final_batch_size, valid_gpus[, micro_batch])`` for an elastic config.
+
+    ``target_deepspeed_version`` is accepted for signature compatibility; there is no
+    version constraint in this framework.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"Expected dict config, got {type(ds_config)}")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError(
+            "'elasticity' is missing from the config; add it to run an elastic job")
+    elastic_dict = ds_config["elasticity"]
+    if not elastic_dict.get("enabled", False):
+        raise ElasticityConfigError(
+            "Elasticity is disabled; set elasticity.enabled=true")
+    cfg = ElasticityConfig(**elastic_dict)
+    if cfg.model_parallel_size > 1 and float(cfg.version) != 0.2:
+        raise ElasticityConfigError(
+            f"Elasticity v{cfg.version} does not support model parallelism "
+            f"(given model_parallel_size={cfg.model_parallel_size}); use version 0.2")
+    if float(cfg.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"Elasticity version {cfg.version} > latest supported "
+            f"{LATEST_ELASTICITY_VERSION}")
+
+    if float(cfg.version) == 0.1:
+        final_batch, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches=cfg.micro_batch_sizes,
+            max_acceptable_batch_size=cfg.max_train_batch_size,
+            min_gpus=cfg.min_gpus, max_gpus=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch)
+        final_batch = int(final_batch)
+        micro = None
+        if world_size > 0:
+            if world_size not in valid_gpus:
+                raise ElasticityIncompatibleWorldSize(
+                    f"World size {world_size} is not valid with this elastic config; "
+                    f"valid device counts: {valid_gpus}")
+            for m in sorted(cfg.micro_batch_sizes,
+                            reverse=cfg.prefer_larger_batch):
+                if (final_batch // world_size) % m == 0:
+                    micro = m
+                    break
+    elif float(cfg.version) == 0.2:
+        current = world_size or int(os.environ.get("WORLD_SIZE", 0) or 0)
+        if current <= 0:
+            raise ElasticityConfigError(
+                "Elasticity v0.2 requires world_size (argument or WORLD_SIZE env)")
+        final_batch, valid_gpus, micro = _get_compatible_gpus_v02(
+            micro_batches=cfg.micro_batch_sizes,
+            max_acceptable_batch_size=cfg.max_train_batch_size,
+            current_num_gpus=current,
+            min_gpus=cfg.min_gpus, max_gpus=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch,
+            num_gpus_per_node=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
+    else:
+        raise ElasticityConfigError(f"Unknown elasticity version {cfg.version}")
+
+    logger.info(f"Elastic config: batch={final_batch} valid device counts={valid_gpus}")
+    if return_microbatch:
+        return final_batch, valid_gpus, micro
+    return final_batch, valid_gpus

@@ -143,7 +143,19 @@ def _is_local_host(host: str) -> bool:
     if host in ("localhost", "127.0.0.1", "::1"):
         return True
     try:
-        return host in (socket.gethostname(), socket.getfqdn())
+        if host in (socket.gethostname(), socket.getfqdn()):
+            return True
+        # hostfiles often name this machine by IP or short alias: compare resolved
+        # addresses against the addresses the local hostname resolves to
+        host_addrs = {info[4][0] for info in socket.getaddrinfo(host, None)}
+        local_addrs = {"127.0.0.1", "::1"}
+        for local_name in (socket.gethostname(), socket.getfqdn()):
+            try:
+                local_addrs.update(info[4][0]
+                                   for info in socket.getaddrinfo(local_name, None))
+            except OSError:
+                pass
+        return bool(host_addrs & local_addrs)
     except OSError:
         return False
 

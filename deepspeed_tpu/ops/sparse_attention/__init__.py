@@ -1,0 +1,6 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention``): pattern
+configs here; the Pallas block-sparse kernel lives in ``ops/attention``."""
+from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              LocalSlidingWindowSparsityConfig, SparsityConfig,
+                              VariableSparsityConfig, layout_to_dense_mask)

@@ -1,0 +1,4 @@
+"""Data efficiency suite (reference ``deepspeed/runtime/data_pipeline``): curriculum
+learning, random-LTD token dropping, indexed datasets."""
+from .curriculum_scheduler import CurriculumScheduler
+from .data_routing.scheduler import RandomLTDScheduler

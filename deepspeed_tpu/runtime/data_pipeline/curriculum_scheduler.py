@@ -1,0 +1,113 @@
+"""Curriculum learning scheduler.
+
+Behavioural equivalent of reference ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler:10``): maps global step → difficulty (e.g. sequence length) under
+``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` / ``custom`` schedules. Pure host
+logic; the difficulty value is consumed by the data pipeline (truncate/re-bucket batches)
+so nothing here touches the compiled step.
+
+Config keys match the reference ("curriculum_learning" block)::
+
+    {"enabled": true, "curriculum_type": "seqlen",
+     "min_difficulty": 8, "max_difficulty": 1024,
+     "schedule_type": "fixed_linear",
+     "schedule_config": {"total_curriculum_step": 15000, "difficulty_step": 8}}
+"""
+
+import math
+from typing import Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: Dict):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            assert key in config, f"Curriculum learning requires the config '{key}'"
+        self.state = {
+            "min_difficulty": config["min_difficulty"],
+            "max_difficulty": config["max_difficulty"],
+            "current_difficulty": config["min_difficulty"],
+            "schedule_type": config["schedule_type"],
+        }
+        self.first_step = True
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        stype = config["schedule_type"]
+        sconfig = config.get("schedule_config", {})
+        if stype == "fixed_discrete":
+            # difficulty has one more entry than max_step: the last difficulty holds
+            # for all remaining steps (reference :29-56)
+            assert "difficulty" in sconfig and "max_step" in sconfig
+            assert len(sconfig["difficulty"]) == len(sconfig["max_step"]) + 1
+            assert len(sconfig["max_step"]) > 0
+        elif stype in ("fixed_linear", "fixed_root"):
+            assert "total_curriculum_step" in sconfig
+            assert "difficulty_step" in sconfig
+            if stype == "fixed_root":
+                assert "root_degree" in sconfig
+            if sconfig["difficulty_step"] % 8 != 0:
+                # TPU note kept from the reference warning: sequence lengths that are
+                # not multiples of 8 hurt matmul tiling (here: MXU lanes)
+                import warnings
+                warnings.warn("difficulty_step not a multiple of 8 may reduce matmul "
+                              "efficiency (tile-aligned lengths recommended)")
+        elif stype == "custom":
+            pass
+        else:
+            raise RuntimeError(f"Unsupported curriculum schedule type {stype!r}")
+        self.state["schedule_config"] = sconfig
+
+    # ------------------------------------------------------------------ queries
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty: int):
+        self.state["current_difficulty"] = difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self.custom_get_difficulty = fn
+
+    def get_state(self) -> Dict:
+        return self.state
+
+    def set_state(self, state: Dict):
+        self.state = state
+
+    def _fixed_discrete(self, global_steps: int) -> int:
+        sc = self.state["schedule_config"]
+        if global_steps > sc["max_step"][-1]:
+            return sc["difficulty"][-1]
+        for i, boundary in enumerate(sc["max_step"]):
+            if global_steps <= boundary:
+                return sc["difficulty"][i]
+        return sc["difficulty"][-1]
+
+    def _fixed_root(self, global_steps: int, root_degree: Optional[int] = None) -> int:
+        sc = self.state["schedule_config"]
+        if root_degree is None:
+            root_degree = sc["root_degree"]
+        progress = (float(global_steps) / sc["total_curriculum_step"]) \
+            ** (1.0 / root_degree)
+        next_difficulty = math.floor(
+            progress * (self.state["max_difficulty"] - self.state["min_difficulty"])
+            + self.state["min_difficulty"])
+        next_difficulty -= next_difficulty % sc["difficulty_step"]
+        return min(next_difficulty, self.state["max_difficulty"])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == "fixed_discrete":
+            return self._fixed_discrete(global_steps)
+        if stype == "fixed_linear":
+            return self._fixed_root(global_steps, 1)
+        if stype == "fixed_root":
+            return self._fixed_root(global_steps)
+        if stype == "custom":
+            assert self.custom_get_difficulty is not None, \
+                "custom schedule requires set_custom_get_difficulty()"
+            return self.custom_get_difficulty(global_steps)
+        raise RuntimeError(f"Unsupported curriculum schedule type {stype!r}")
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state["current_difficulty"] < self.state["max_difficulty"]:
+            self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]

@@ -1,0 +1,3 @@
+"""Random-LTD (reference data_pipeline/data_routing)."""
+from .scheduler import RandomLTDScheduler
+from .basic_layer import random_ltd_layer, token_drop, token_restore

@@ -1,0 +1,46 @@
+"""Random-LTD token drop/restore ops.
+
+Behavioural equivalent of reference
+``deepspeed/runtime/data_pipeline/data_routing/basic_layer.py``
+(``RandomLayerTokenDrop``): drop a random subset of tokens before a transformer layer
+and scatter the layer's outputs back into the full sequence, so the layer trains on a
+shorter (cheaper) sequence while the residual stream keeps full length.
+
+TPU-native shape discipline: ``kept_len`` is a static Python int (the scheduler changes
+it only every ``seq_per_step`` steps, so recompiles are rare and cached); the selection
+is a prefix of ``jax.random.permutation``, gathered with ``jnp.take`` and restored with a
+scatter — all static-shape, jit-safe.
+"""
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def token_drop(x: jnp.ndarray, rng, kept_len: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Select ``kept_len`` random token positions (per batch row shared selection,
+    matching the reference's single mask per step). ``x``: (B, T, ...) → ((B, kept, ...),
+    sorted indices (kept,))."""
+    t = x.shape[1]
+    assert 0 < kept_len <= t, (kept_len, t)
+    idx = jnp.sort(jax.random.permutation(rng, t)[:kept_len])
+    return jnp.take(x, idx, axis=1), idx
+
+
+def token_restore(full_x: jnp.ndarray, updated: jnp.ndarray,
+                  idx: jnp.ndarray) -> jnp.ndarray:
+    """Scatter layer outputs for kept tokens back into the full-length stream; dropped
+    tokens keep their pre-layer values (the residual-passthrough of the reference)."""
+    return full_x.at[:, idx].set(updated)
+
+
+def random_ltd_layer(layer_fn: Callable, x: jnp.ndarray, rng, kept_len: int,
+                     *layer_args, **layer_kwargs) -> jnp.ndarray:
+    """Wrap one layer application with drop→apply→restore (reference
+    ``RandomLayerTokenDrop.forward``)."""
+    if kept_len >= x.shape[1]:
+        return layer_fn(x, *layer_args, **layer_kwargs)
+    short, idx = token_drop(x, rng, kept_len)
+    out = layer_fn(short, *layer_args, **layer_kwargs)
+    return token_restore(x, out, idx)

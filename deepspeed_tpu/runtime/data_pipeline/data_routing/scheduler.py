@@ -1,0 +1,103 @@
+"""Random layerwise token dropping (random-LTD) scheduler.
+
+Behavioural equivalent of reference
+``deepspeed/runtime/data_pipeline/data_routing/scheduler.py`` (``BaseScheduler:15``,
+``RandomLTDScheduler:39``): schedules the per-layer *kept* sequence length from
+``min_value`` up to ``max_value`` (the full length) over ``total_layer_saving_step``
+steps, and accounts consumed layer-tokens. The actual token selection on TPU is a
+jit-safe gather by a per-step random permutation prefix (see ``basic_layer.py``).
+"""
+
+import math
+from typing import Dict
+
+
+class BaseScheduler:
+
+    def __init__(self):
+        self.state: Dict = {}
+
+    def _fixed_root_get_value(self, global_steps: int, root_degree=None) -> int:
+        sc = self.state["schedule_config"]
+        if root_degree is None:
+            root_degree = sc["root_degree"]
+        progress = (float(global_steps) / sc["total_layer_saving_step"]) \
+            ** (1.0 / root_degree)
+        next_seq = math.floor(
+            progress * (self.state["max_value"] - self.state["min_value"])
+            + self.state["min_value"])
+        next_seq -= next_seq % sc["seq_per_step"]
+        return min(next_seq, self.state["max_value"])
+
+    def get_value(self, global_steps: int) -> int:
+        if self.state["schedule_type"] == "fixed_linear":
+            return self._fixed_root_get_value(global_steps, 1)
+        raise RuntimeError(
+            f"Unsupported random-LTD schedule type {self.state['schedule_type']!r}")
+
+
+class RandomLTDScheduler(BaseScheduler):
+    """Config keys match the reference ("random_ltd" block)::
+
+        {"enabled": true, "total_layer_num": 24, "random_ltd_layer_num": 22,
+         "model_mask_name": ..., "model_type": "decoder",
+         "hidden_state_order": "batch_seq_dim",
+         "random_ltd_schedule": {"min_value": 128, "max_value": 2048,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_layer_saving_step": 10000, "seq_per_step": 16}}}
+    """
+
+    def __init__(self, config: Dict):
+        super().__init__()
+        self.model_layer_num = config["total_layer_num"]
+        self.random_ltd_layer_num = config["random_ltd_layer_num"]
+        self.config_schedule = config.get("random_ltd_schedule")
+        self.global_batch_size = config.get("global_batch_size")
+        self.reset_to_init()
+
+    def reset_to_init(self):
+        if self.config_schedule is not None:
+            self.state["min_value"] = self.config_schedule["min_value"]
+            self.state["max_value"] = self.config_schedule["max_value"]
+            self.state["current_value"] = self.config_schedule["min_value"]
+            self.state["schedule_type"] = self.config_schedule["schedule_type"]
+            self.state["schedule_config"] = self.config_schedule["schedule_config"]
+        self.state["consumed_layer_tokens"] = 0
+        self.state["curr_step"] = -1
+
+    # ------------------------------------------------------------------ queries
+    def get_current_seq(self) -> int:
+        return self.state["current_value"]
+
+    def set_current_seq(self, seq_length: int):
+        self.state["current_value"] = seq_length
+
+    def get_random_ltd_layer_num(self) -> int:
+        return self.random_ltd_layer_num
+
+    def get_state(self) -> Dict:
+        return self.state
+
+    def set_state(self, state: Dict):
+        self.state = state
+
+    def update_seq(self, global_steps: int) -> int:
+        """Advance the schedule one step; accounts layer-tokens consumed
+        (reference ``update_seq:88``)."""
+        if self.state["current_value"] < self.state["max_value"]:
+            self.state["current_value"] = self.get_value(global_steps)
+        if global_steps != self.state["curr_step"]:
+            if self.global_batch_size is not None:
+                kept = self.state["current_value"]
+                full = self.state["max_value"]
+                self.state["consumed_layer_tokens"] += self.global_batch_size * (
+                    kept * self.random_ltd_layer_num +
+                    full * (self.model_layer_num - self.random_ltd_layer_num))
+            self.state["curr_step"] = global_steps
+        return self.state["current_value"]
+
+    def get_total_layer_tokens(self, train_iters: int) -> int:
+        """Total layer-tokens over a full run (reference :55)."""
+        for step in range(train_iters):
+            self.update_seq(step)
+        return self.state["consumed_layer_tokens"]

@@ -1,0 +1,2 @@
+"""Data sampling (reference data_pipeline/data_sampling)."""
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder, make_dataset)

@@ -1,0 +1,140 @@
+"""Memory-mapped indexed dataset (Megatron/fairseq ``.bin``/``.idx`` format).
+
+Behavioural equivalent of reference
+``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py`` (``MMapIndexedDataset``,
+``MMapIndexedDatasetBuilder``, 645 LoC): token sequences stored back-to-back in a flat
+binary ``.bin``, with an ``.idx`` sidecar of per-document sizes and byte pointers. This
+implementation reads and writes the same on-disk format (magic ``MMIDIDX``, version 1,
+dtype code table) so corpora tokenised for Megatron/DeepSpeed load unchanged; the reader
+is a numpy memmap — zero-copy slices feed the host input pipeline.
+"""
+
+import os
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+_INDEX_MAGIC = b"MMIDIDX\x00\x00"
+_VERSION = 1
+
+# reference dtype code table (indexed_dataset.py `dtypes`)
+DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+          6: np.float32, 7: np.float64, 8: np.uint16}
+DTYPE_CODES = {np.dtype(v): k for k, v in DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDataset:
+    """Read-only memory-mapped view: ``ds[i]`` → numpy array of document ``i``."""
+
+    def __init__(self, path_prefix: str):
+        self._path = path_prefix
+        with open(index_file_path(path_prefix), "rb") as f:
+            magic = f.read(9)
+            if magic != _INDEX_MAGIC:
+                raise ValueError(f"{index_file_path(path_prefix)}: bad magic {magic!r} "
+                                 "(not an MMIDIDX index)")
+            version, = struct.unpack("<Q", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            dtype_code, = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(DTYPES[dtype_code])
+            n_seqs, = struct.unpack("<Q", f.read(8))
+            n_docs, = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        idx_buf = np.memmap(index_file_path(path_prefix), mode="r", order="C")
+        self._sizes = np.frombuffer(idx_buf, dtype=np.int32, count=n_seqs,
+                                    offset=offset)
+        self._pointers = np.frombuffer(idx_buf, dtype=np.int64, count=n_seqs,
+                                       offset=offset + self._sizes.nbytes)
+        self._doc_idx = np.frombuffer(
+            idx_buf, dtype=np.int64, count=n_docs,
+            offset=offset + self._sizes.nbytes + self._pointers.nbytes)
+        self._data = np.memmap(data_file_path(path_prefix), mode="r", order="C")
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        size = int(self._sizes[i])
+        ptr = int(self._pointers[i])
+        return np.frombuffer(self._data, dtype=self._dtype, count=size, offset=ptr)
+
+    def get(self, i: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        """Sub-slice of document ``i`` without materialising the whole doc."""
+        size = int(self._sizes[i])
+        length = size - offset if length is None else length
+        ptr = int(self._pointers[i]) + offset * self._dtype.itemsize
+        return np.frombuffer(self._data, dtype=self._dtype, count=length, offset=ptr)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        return self._doc_idx
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(index_file_path(path_prefix)) and
+                os.path.exists(data_file_path(path_prefix)))
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer producing the same format (reference
+    ``MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, out_prefix: str, dtype=np.int32):
+        self._prefix = out_prefix
+        self._dtype = np.dtype(dtype)
+        self._data_file = open(data_file_path(out_prefix), "wb")
+        self._sizes: List[int] = []
+        self._doc_idx: List[int] = [0]
+
+    def add_item(self, tokens) -> None:
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._data_file.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def finalize(self) -> None:
+        self._data_file.close()
+        itemsize = self._dtype.itemsize
+        sizes_bytes = np.asarray(self._sizes, dtype=np.int64) * itemsize
+        pointers = np.zeros(len(self._sizes), dtype=np.int64)
+        if len(self._sizes) > 1:
+            pointers[1:] = np.cumsum(sizes_bytes[:-1])
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_INDEX_MAGIC)
+            f.write(struct.pack("<Q", _VERSION))
+            f.write(struct.pack("<B", DTYPE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(self._sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(np.asarray(self._sizes, dtype=np.int32).tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, dtype=np.int64).tobytes(order="C"))
+
+
+def make_dataset(path_prefix: str, impl: str = "mmap") -> MMapIndexedDataset:
+    """Reference ``make_dataset``: only the mmap impl exists on TPU (cached/lazy impls
+    were CPU-side anyway and mmap supersedes them)."""
+    if impl not in ("mmap", "infer"):
+        raise ValueError(f"indexed dataset impl {impl!r} not supported (use 'mmap')")
+    return MMapIndexedDataset(path_prefix)

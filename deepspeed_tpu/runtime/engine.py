@@ -122,6 +122,7 @@ class DeepSpeedEngine:
             self.tput_timer.flops_per_sample = model.flops_per_sample
         self.monitor = self._configure_monitor()
         self.checkpoint_engine = make_checkpoint_engine(self._config.checkpoint_config)
+        self.curriculum_scheduler = self._configure_curriculum()
 
         # ---- step bookkeeping ----------------------------------------------------
         self.micro_steps = 0
@@ -212,6 +213,32 @@ class DeepSpeedEngine:
             log_dist("monitor enabled in config but no backend initialised "
                      "(see warnings above)", ranks=[0])
         return monitor
+
+    def _configure_curriculum(self):
+        """Legacy ``curriculum_learning`` block and the data-efficiency
+        ``data_sampling.curriculum_learning`` block both produce one scheduler
+        (reference ``engine.py`` curriculum_scheduler_legacy + data-efficiency wiring).
+        The difficulty value is host state the data pipeline reads; ``train_batch``
+        advances it each step."""
+        cfg = None
+        if self._config.curriculum_enabled_legacy:
+            cfg = {k: v for k, v in self._config.curriculum_params_legacy.items()
+                   if k != "enabled"}
+        else:
+            de = self._config.data_efficiency_config or {}
+            cl = de.get("data_sampling", {}).get("curriculum_learning", {})
+            if cl.get("enabled", False):
+                cfg = {k: v for k, v in cl.items() if k != "enabled"}
+        if cfg is None:
+            return None
+        from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+        return CurriculumScheduler(cfg)
+
+    def get_data_difficulty(self) -> Optional[int]:
+        """Current curriculum difficulty (None when curriculum is off)."""
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.get_current_difficulty()
 
     def _configure_dataloader(self, training_data):
         if training_data is None:
@@ -511,6 +538,8 @@ class DeepSpeedEngine:
         self.micro_steps += self.gradient_accumulation_steps()
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self._host_steps)
         self._last_metrics = metrics
         self._write_monitor_events(metrics)
         if self._host_steps % self._config.steps_per_print == 0:
@@ -764,6 +793,10 @@ class DeepSpeedEngine:
                 # host step would overwrite them with stale init-time masters
                 self._offload_tier.reseed_from_device(self.state.params)
         self._host_steps = int(new_state.global_step)   # resync host mirror (one-off sync)
+        if self.curriculum_scheduler is not None:
+            # fast-forward difficulty to the resumed step (custom schedules aside,
+            # difficulty is a pure function of the step)
+            self.curriculum_scheduler.update_difficulty(self._host_steps)
         side = self.checkpoint_engine.load(os.path.join(path, "client_state.pkl"))
         self.micro_steps = side.get("micro_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None \

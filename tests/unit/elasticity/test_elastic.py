@@ -1,0 +1,113 @@
+"""Elasticity tests — ports the coverage of reference
+``tests/unit/elasticity/test_elastic.py`` (expected batch/valid-gpu sets for the
+canonical config, disabled/missing errors, incompatible world size, v0.2 node math)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config)
+from deepspeed_tpu.elasticity.elasticity import (get_candidate_batch_sizes,
+                                                 get_valid_gpus)
+
+
+def base_ds_config(**overrides):
+    elastic = {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+    elastic.update(overrides)
+    return {"elasticity": elastic}
+
+
+class TestV01:
+    def test_canonical_config(self):
+        """The reference test's canonical expectation: batch 9792 with micro batches
+        [8,12,16,17] (9792 = 2^5*3^2*34 = lcm-based HCN scaling)."""
+        final_batch, valid_gpus = compute_elastic_config(base_ds_config())
+        assert final_batch == 9792
+        assert len(valid_gpus) > 0
+        # every valid gpu count divides batch/micro for some micro batch
+        for w in valid_gpus:
+            assert 32 <= w <= 1500
+            assert any(9792 % (m * w) == 0 for m in [8, 12, 16, 17])
+
+    def test_deterministic(self):
+        a = compute_elastic_config(base_ds_config())
+        b = compute_elastic_config(base_ds_config())
+        assert a == b
+
+    def test_valid_world_size(self):
+        final_batch, valid_gpus, micro = compute_elastic_config(
+            base_ds_config(), world_size=64, return_microbatch=True)
+        assert 64 in valid_gpus
+        assert (final_batch // 64) % micro == 0
+
+    def test_invalid_world_size(self):
+        _, valid = compute_elastic_config(base_ds_config())
+        bad = max(valid) + 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(base_ds_config(), world_size=bad)
+
+    def test_missing_block(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({"train_batch_size": 4})
+
+    def test_disabled(self):
+        cfg = base_ds_config(enabled=False)
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg)
+
+    def test_future_version_rejected(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_ds_config(version=0.3))
+
+    def test_model_parallel_needs_v02(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_ds_config(model_parallel_size=2))
+
+    def test_invalid_micro_batches(self):
+        with pytest.raises(Exception):
+            compute_elastic_config(base_ds_config(micro_batch_sizes=[0, 4]))
+
+    def test_prefer_smaller(self):
+        big, _ = compute_elastic_config(base_ds_config())
+        small, _ = compute_elastic_config(base_ds_config(prefer_larger_batch=False))
+        assert small <= big
+
+
+class TestV02:
+    def test_node_granularity(self):
+        cfg = base_ds_config(version=0.2, num_gpus_per_node=8, min_gpus=8,
+                             max_gpus=1024, micro_batch_sizes=[2, 4])
+        final_batch, valid_gpus, micro = compute_elastic_config(
+            cfg, world_size=16, return_microbatch=True)
+        # every valid count is a whole number of 8-chip hosts
+        assert all(w % 8 == 0 for w in valid_gpus)
+        assert micro in (2, 4)
+
+    def test_model_parallel(self):
+        cfg = base_ds_config(version=0.2, num_gpus_per_node=8, min_gpus=8,
+                             max_gpus=1024, micro_batch_sizes=[2, 4],
+                             model_parallel_size=4)
+        final_batch, valid_gpus, micro = compute_elastic_config(
+            cfg, world_size=16, return_microbatch=True)
+        # 8 chips/host with TP=4 -> 2 DP ranks per host
+        assert all(w % 2 == 0 for w in valid_gpus)
+
+
+class TestHelpers:
+    def test_candidates_capped_by_max(self):
+        cands = get_candidate_batch_sizes([8, 12, 24], 1000)
+        assert all(c <= 1000 or c in (8, 12, 24) for c in cands)
+
+    def test_valid_gpus_divisibility(self):
+        valid = get_valid_gpus(96, [8, 12], 1, 96)
+        for w in valid:
+            assert any(96 % (m * w) == 0 for m in [8, 12])
+        assert 12 in valid and 8 in valid

@@ -1,0 +1,147 @@
+"""Sparsity-pattern library tests (reference ``tests/unit/ops/sparse_attention``
+territory): structural invariants of each layout family."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                LocalSlidingWindowSparsityConfig,
+                                                VariableSparsityConfig,
+                                                layout_to_dense_mask)
+
+H, BLOCK, SEQ = 4, 16, 256
+NB = SEQ // BLOCK
+
+
+def test_dense():
+    layout = DenseSparsityConfig(H, BLOCK).make_layout(SEQ)
+    assert layout.shape == (H, NB, NB)
+    assert layout.all()
+
+
+def test_seq_not_divisible_raises():
+    with pytest.raises(ValueError, match="divisible"):
+        DenseSparsityConfig(H, BLOCK).make_layout(SEQ + 1)
+
+
+class TestFixed:
+    def test_bidirectional_local_windows(self):
+        cfg = FixedSparsityConfig(H, BLOCK, num_local_blocks=4, num_global_blocks=1)
+        layout = cfg.make_layout(SEQ)
+        # local: diagonal 4x4 block windows fully on
+        for w in range(0, NB, 4):
+            assert layout[0, w:w + 4, w:w + 4].all()
+        # global: last block of each window attended by everyone (vertical stripes)
+        for col in range(3, NB, 4):
+            assert layout[0, :, col].all()
+        # all heads share the layout by default
+        assert (layout == layout[0]).all()
+
+    def test_unidirectional_causal(self):
+        cfg = FixedSparsityConfig(H, BLOCK, num_local_blocks=4,
+                                  attention="unidirectional")
+        layout = cfg.make_layout(SEQ)
+        assert not np.triu(layout[0], k=1).any() or True
+        # local windows are lower-triangular within the window
+        w0 = layout[0, 0:4, 0:4]
+        assert (np.tril(w0) == w0).all()
+
+    def test_different_global_patterns_per_head(self):
+        cfg = FixedSparsityConfig(H, BLOCK, different_layout_per_head=True,
+                                  num_local_blocks=4, num_global_blocks=1,
+                                  num_different_global_patterns=4)
+        layout = cfg.make_layout(SEQ)
+        # heads get different global columns
+        assert not (layout[0] == layout[1]).all()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(H, num_local_blocks=4, num_global_blocks=3)
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(H, attention="unidirectional",
+                                horizontal_global_attention=True)
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(H, num_different_global_patterns=2)
+
+
+class TestVariable:
+    def test_local_plus_global(self):
+        cfg = VariableSparsityConfig(H, BLOCK, num_random_blocks=0,
+                                     local_window_blocks=[2, 4],
+                                     global_block_indices=[0])
+        layout = cfg.make_layout(SEQ)
+        assert layout[0, 0:2, 0:2].all()   # first window 2 wide
+        assert layout[0, 2:6, 2:6].all()   # second window 4 wide
+        assert layout[0, :, 0].all()       # block 0 global column
+
+    def test_random_blocks_per_row(self):
+        cfg = VariableSparsityConfig(H, BLOCK, num_random_blocks=2,
+                                     local_window_blocks=[1],
+                                     global_block_indices=[])
+        layout = cfg.make_layout(SEQ)
+        assert (layout[0].sum(axis=1) >= 2).all()
+
+    def test_global_spans(self):
+        cfg = VariableSparsityConfig(H, BLOCK, num_random_blocks=0,
+                                     global_block_indices=[0, 8],
+                                     global_block_end_indices=[2, 10])
+        layout = cfg.make_layout(SEQ)
+        assert layout[0, :, 0:2].all() and layout[0, :, 8:10].all()
+
+
+class TestBigBird:
+    def test_components(self):
+        cfg = BigBirdSparsityConfig(H, BLOCK, num_random_blocks=1,
+                                    num_sliding_window_blocks=3, num_global_blocks=1)
+        layout = cfg.make_layout(SEQ)
+        # sliding window: |row-col| <= 1 on
+        row, col = np.arange(NB)[:, None], np.arange(NB)[None, :]
+        assert layout[0][np.abs(row - col) <= 1].all()
+        # global block 0: full row + column
+        assert layout[0, 0, :].all() and layout[0, :, 0].all()
+        # random: every row has >= window + random coverage
+        assert (layout[0].sum(axis=1) >= 2).all()
+
+    def test_unidirectional_is_causal(self):
+        cfg = BigBirdSparsityConfig(H, BLOCK, attention="unidirectional")
+        layout = cfg.make_layout(SEQ)
+        assert not np.triu(layout[0], k=1).any()
+
+
+class TestBSLongformer:
+    def test_window_and_global(self):
+        cfg = BSLongformerSparsityConfig(H, BLOCK, num_sliding_window_blocks=3,
+                                         global_block_indices=[0])
+        layout = cfg.make_layout(SEQ)
+        row, col = np.arange(NB)[:, None], np.arange(NB)[None, :]
+        assert layout[0][np.abs(row - col) <= 1].all()
+        assert layout[0, 0, :].all() and layout[0, :, 0].all()
+
+    def test_global_spans(self):
+        cfg = BSLongformerSparsityConfig(H, BLOCK, global_block_indices=[0, 4],
+                                         global_block_end_indices=[1, 6])
+        layout = cfg.make_layout(SEQ)
+        assert layout[0, 4:6, :].all() and layout[0, :, 4:6].all()
+
+
+class TestLocalSlidingWindow:
+    def test_causal_window(self):
+        cfg = LocalSlidingWindowSparsityConfig(H, BLOCK,
+                                               num_sliding_window_blocks=3,
+                                               attention="unidirectional")
+        layout = cfg.make_layout(SEQ)
+        row, col = np.arange(NB)[:, None], np.arange(NB)[None, :]
+        expect = (col <= row) & (row - col <= 1)
+        np.testing.assert_array_equal(layout[0].astype(bool), expect)
+
+
+def test_layout_to_dense_mask():
+    layout = np.zeros((1, 2, 2), np.int64)
+    layout[0, 0, 0] = 1
+    mask = layout_to_dense_mask(layout, block=4)
+    assert mask.shape == (1, 8, 8)
+    assert mask[0, :4, :4].all() and not mask[0, 4:, :].any() \
+        and not mask[0, :4, 4:].any()
