@@ -1,0 +1,69 @@
+"""Sign-compressed (1-bit) allreduce with error feedback.
+
+Behavioural equivalent of reference ``deepspeed/runtime/comm/nccl.py``
+(``NcclBackend.compressed_allreduce:52``) / ``comm/mpi.py``: each worker ships only the
+SIGN of its (error-compensated) tensor plus one L1 scale, cutting collective volume
+~32× for the momentum exchange of the 1-bit optimizers.
+
+TPU-native realisation: an in-graph collective for use inside ``shard_map`` over a mesh
+axis. Signs are bit-packed into uint8 lanes (8 signs/byte) so the ``all_gather`` actually
+moves 1 bit per element over ICI; unpack + scale-weighted mean reconstructs the
+compressed average. Error feedback (worker residual carried to the next step) preserves
+convergence (1-bit Adam paper, Tang et al. 2021).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(n,) bool -> (ceil(n/8),) uint8 bitmask."""
+    n = bits.shape[0]
+    pad = (-n) % 8
+    b = jnp.pad(bits.astype(jnp.uint8), (0, pad)).reshape(-1, 8)
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(b * weights, axis=1).astype(jnp.uint8)
+
+
+def _unpack_bits(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """(m,) uint8 -> (n,) bool."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & 1
+    return bits.reshape(-1)[:n].astype(bool)
+
+
+def compress_signs(x: jnp.ndarray,
+                   error: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                jnp.ndarray]:
+    """Error-compensated 1-bit compression of a flat fp32 tensor.
+
+    Returns ``(packed_signs uint8, scale, new_error)`` with
+    ``decompress(packed, scale) + new_error == x + error`` exactly.
+    """
+    c = x + error
+    scale = jnp.mean(jnp.abs(c))
+    signs = c >= 0
+    compressed = jnp.where(signs, scale, -scale)
+    new_error = c - compressed
+    return _pack_bits(signs), scale, new_error
+
+
+def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray,
+                         axis_name: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """1-bit mean over ``axis_name`` (call inside ``shard_map``); returns
+    ``(mean of compressed worker tensors, new local error)``.
+
+    Collective volume: n/8 bytes of signs + 4 bytes of scale per worker (vs 4n bytes
+    for a full fp32 allreduce).
+    """
+    shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    err = error.reshape(-1).astype(jnp.float32)
+    packed, scale, new_error = compress_signs(flat, err)
+    gathered = jax.lax.all_gather(packed, axis_name)      # (W, n/8) uint8
+    scales = jax.lax.all_gather(scale, axis_name)         # (W,)
+    n = flat.shape[0]
+    signs = jax.vmap(lambda p: _unpack_bits(p, n))(gathered)  # (W, n) bool
+    avg = jnp.mean(jnp.where(signs, scales[:, None], -scales[:, None]), axis=0)
+    return avg.reshape(shape), new_error.reshape(shape)

@@ -1,0 +1,8 @@
+"""Compression suite (reference ``deepspeed/compression``): QAT quantization,
+structured pruning, layer reduction — functional, jit-safe transforms."""
+from .basic_layer import (channel_mask, head_mask, quantize_activation,
+                          quantize_dequantize, row_mask, sparse_mask)
+from .compress import (init_compression, redundancy_clean, stacked_layer_reduction,
+                       student_initialization)
+from .config import CompressionConfig
+from .scheduler import CompressionScheduler

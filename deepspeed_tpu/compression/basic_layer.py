@@ -1,0 +1,142 @@
+"""Compression primitives: QAT quantization + structured pruning masks.
+
+Behavioural equivalent of reference ``deepspeed/compression/basic_layer.py`` (925 LoC:
+``LinearLayer_Compress``, ``QuantAct``, ``Embedding_Compress``) re-designed functionally:
+instead of nn.Module subclasses holding mutable masks, these are pure jit-safe transforms
+on weight arrays. Quantize-dequantize uses a straight-through estimator
+(``jax.custom_vjp`` identity backward — the ``SymQuantizer.apply``/autograd.Function role);
+masks are plain multiplications, so masked weights get zero gradient exactly as the
+reference's ``weight * mask`` forward does.
+
+All transforms accept traced step-dependent arguments (e.g. annealed ``bits``), so the
+compression schedule runs inside the compiled train step without recompilation.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------- quantization
+@partial(jax.custom_vjp, nondiff_argnames=())
+def _ste(x, qx):
+    """Forward: quantized value; backward: identity to x (straight-through)."""
+    return qx
+
+
+def _ste_fwd(x, qx):
+    return qx, None
+
+
+def _ste_bwd(_, g):
+    return g, jnp.zeros_like(g)
+
+
+_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def _grouped(x, groups: int):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    g = groups
+    while n % g:
+        g -= 1
+    return flat.reshape(g, n // g), g
+
+
+def quantize_dequantize(x, bits, quantization_type: str = "symmetric",
+                        groups: int = 1, stochastic: bool = False,
+                        rng: Optional[jax.Array] = None):
+    """Fake-quantize ``x`` to ``bits`` (traced ok) per group; straight-through grads.
+
+    symmetric: scale = max|x| / (2^(b-1)-1), zero-point-free (reference SymQuantizer);
+    asymmetric: affine over [min, max] (reference AsymQuantizer).
+    """
+    orig_shape, orig_dtype = x.shape, x.dtype
+    xg, _ = _grouped(x.astype(jnp.float32), groups)
+    bits = jnp.asarray(bits, jnp.float32)
+    if quantization_type == "symmetric":
+        qmax = 2.0 ** (bits - 1.0) - 1.0
+        amax = jnp.max(jnp.abs(xg), axis=1, keepdims=True)
+        scale = jnp.where(amax > 0, amax / qmax, 1.0)
+        q = xg / scale
+        if stochastic:
+            assert rng is not None, "stochastic rounding needs an rng"
+            q = jnp.floor(q + jax.random.uniform(rng, q.shape))
+        else:
+            q = jnp.round(q)
+        q = jnp.clip(q, -qmax, qmax) * scale
+    elif quantization_type == "asymmetric":
+        levels = 2.0 ** bits - 1.0
+        lo = jnp.min(xg, axis=1, keepdims=True)
+        hi = jnp.max(xg, axis=1, keepdims=True)
+        scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+        q = (xg - lo) / scale
+        if stochastic:
+            assert rng is not None, "stochastic rounding needs an rng"
+            q = jnp.floor(q + jax.random.uniform(rng, q.shape))
+        else:
+            q = jnp.round(q)
+        q = jnp.clip(q, 0.0, levels) * scale + lo
+    else:
+        raise ValueError(f"quantization_type {quantization_type!r} "
+                         "(symmetric|asymmetric)")
+    q = q.reshape(orig_shape).astype(orig_dtype)
+    return _ste(x, q)
+
+
+def quantize_activation(x, bits, quantization_type: str = "symmetric",
+                        static_range: Optional[tuple] = None):
+    """Activation fake-quant (reference ``QuantAct``): dynamic per-tensor range, or a
+    calibrated static range."""
+    if static_range is not None:
+        lo, hi = static_range
+        x = jnp.clip(x, lo, hi)
+    return quantize_dequantize(x, bits, quantization_type, groups=1)
+
+
+# --------------------------------------------------------------------- pruning masks
+def sparse_mask(w, dense_ratio: float, method: str = "l1"):
+    """Unstructured |w| top-k mask (reference ``enable_sparse_pruning`` l1/topk)."""
+    flat = jnp.abs(w.reshape(-1))
+    k = max(1, int(flat.shape[0] * dense_ratio))
+    threshold = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(w) >= threshold).astype(w.dtype)
+
+
+def row_mask(w, dense_ratio: float, method: str = "l1"):
+    """Keep rows (output neurons, dim 0) with largest L1 norm (reference
+    ``enable_row_pruning``)."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    k = max(1, int(norms.shape[0] * dense_ratio))
+    threshold = jax.lax.top_k(norms, k)[0][-1]
+    keep = norms >= threshold
+    return keep.astype(w.dtype).reshape((-1,) + (1,) * (w.ndim - 1))
+
+
+def head_mask(w, dense_ratio: float, num_heads: int, method: str = "l1"):
+    """Keep attention heads with largest L1 norm; ``w`` is the attention output
+    projection (in_dim split into heads along dim 0 — reference
+    ``enable_head_pruning`` on attn_ow)."""
+    in_dim = w.shape[0]
+    assert in_dim % num_heads == 0, (in_dim, num_heads)
+    per_head = w.reshape(num_heads, in_dim // num_heads, *w.shape[1:])
+    norms = jnp.sum(jnp.abs(per_head), axis=tuple(range(1, per_head.ndim)))
+    k = max(1, int(num_heads * dense_ratio))
+    threshold = jax.lax.top_k(norms, k)[0][-1]
+    keep = (norms >= threshold).astype(w.dtype)
+    return jnp.repeat(keep, in_dim // num_heads).reshape(
+        (in_dim,) + (1,) * (w.ndim - 1))
+
+
+def channel_mask(w, dense_ratio: float, method: str = "l1"):
+    """Keep input channels (dim 1) with largest L1 norm (reference
+    ``enable_channel_pruning`` for conv)."""
+    axes = (0,) + tuple(range(2, w.ndim))
+    norms = jnp.sum(jnp.abs(w), axis=axes)
+    k = max(1, int(norms.shape[0] * dense_ratio))
+    threshold = jax.lax.top_k(norms, k)[0][-1]
+    keep = norms >= threshold
+    return keep.astype(w.dtype).reshape((1, -1) + (1,) * (w.ndim - 2))

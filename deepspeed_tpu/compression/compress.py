@@ -1,0 +1,95 @@
+"""Compression entry points.
+
+Behavioural equivalent of reference ``deepspeed/compression/compress.py``
+(``init_compression:31``, ``redundancy_clean:103``, ``student_initialization:161``):
+
+- :func:`init_compression` builds a :class:`CompressionScheduler` from a ds_config —
+  the engine calls it automatically when ``compression_training`` is present and runs
+  the scheduler's QAT transform inside the compiled step;
+- :func:`redundancy_clean` bakes pruning masks into the weights permanently (the
+  reference's ``fix_*_helper`` pass after training);
+- :func:`student_initialization` implements layer_reduction: initialise a shallow
+  student from chosen teacher layers.
+"""
+
+import re
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .config import CompressionConfig
+from .scheduler import CompressionScheduler, _path_str
+
+
+def init_compression(abstract_or_params: Any,
+                     ds_config: Union[dict, "object"]) -> CompressionScheduler:
+    """Reference ``init_compression:31``: returns the scheduler (the model is pure
+    data here — no module surgery to do)."""
+    if isinstance(ds_config, dict):
+        cc = CompressionConfig(ds_config.get("compression_training", ds_config))
+    elif isinstance(ds_config, CompressionConfig):
+        cc = ds_config
+    else:
+        cc = CompressionConfig(getattr(ds_config, "compression_config", {}))
+    return CompressionScheduler(cc, abstract_or_params)
+
+
+def redundancy_clean(params: Any, ds_config: Union[dict, CompressionConfig]) -> Any:
+    """Apply final masks destructively (reference ``redundancy_clean:103``); quantized
+    groups are fake-quantized at target bits so the saved weights equal serving-time
+    values."""
+    scheduler = init_compression(params, ds_config)
+    import numpy as np
+    final_step = jnp.int32(2 ** 30)  # all schedule offsets passed, bits at target
+    return scheduler.qat(params, final_step)
+
+
+def student_initialization(student_params: Any, teacher_params: Any,
+                           ds_config: Union[dict, CompressionConfig]) -> Any:
+    """Layer reduction (reference ``student_initialization:161``): copy
+    ``teacher_layer[i]`` of the teacher into layer ``i`` of the student for params
+    matching ``module_name_prefix.<index>.``; ``other_module_name`` params copy as-is.
+    """
+    if isinstance(ds_config, CompressionConfig):
+        cc = ds_config
+    else:
+        cc = CompressionConfig(ds_config.get("compression_training", ds_config))
+    lr = cc.layer_reduction
+    assert lr.enabled, "layer_reduction not enabled"
+    teacher_flat = {_path_str(p): l for p, l in
+                    jax.tree_util.tree_flatten_with_path(teacher_params)[0]}
+    prefix = lr.module_name_prefix
+
+    def remap(path_str: str):
+        """student path -> teacher path (student layer i reads teacher_layer[i])."""
+        if prefix and path_str.startswith(prefix):
+            rest = path_str[len(prefix):].lstrip(".")
+            m = re.match(r"(\d+)(.*)", rest)
+            if m:
+                idx = int(m.group(1))
+                if idx < len(lr.teacher_layer):
+                    t_idx = lr.teacher_layer[idx]
+                    return f"{prefix}.{t_idx}{m.group(2)}" \
+                        if not prefix.endswith(".") else \
+                        f"{prefix}{t_idx}{m.group(2)}"
+        return path_str
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(student_params)
+    out = []
+    for path, leaf in flat:
+        pstr = _path_str(path)
+        src = remap(pstr)
+        t = teacher_flat.get(src)
+        if t is not None and tuple(t.shape) == tuple(leaf.shape):
+            out.append(jnp.asarray(t, leaf.dtype))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def stacked_layer_reduction(teacher_stack: Any, teacher_layers) -> Any:
+    """TPU-native convenience for our stacked-body models (params["body"] leaves with a
+    leading layer dim): student body = teacher body gathered at ``teacher_layers``."""
+    idx = jnp.asarray(list(teacher_layers), jnp.int32)
+    return jax.tree_util.tree_map(lambda l: l[idx], teacher_stack)

@@ -1,0 +1,138 @@
+"""Compression configuration.
+
+Behavioural equivalent of reference ``deepspeed/compression/config.py`` (the
+``get_*`` parser pile over ``constants.py`` keys) as pydantic models. Same JSON surface
+under ``"compression_training"``: ``weight_quantization`` / ``activation_quantization`` /
+``sparse_pruning`` / ``row_pruning`` / ``head_pruning`` / ``channel_pruning`` each with
+``shared_parameters`` + ``different_groups``, plus ``layer_reduction``.
+"""
+
+from typing import Dict, List, Optional
+
+from pydantic import Field
+
+from ..config.config_utils import ConfigModel
+
+
+class FP16MixedQuantize(ConfigModel):
+    enabled: bool = False
+    quantize_change_ratio: float = Field(0.001, ge=0)
+
+
+class WeightQuantizeShared(ConfigModel):
+    """Reference ``get_weight_quantization_shared_parameters`` keys."""
+    enabled: bool = False
+    quantizer_kernel: bool = False
+    schedule_offset: int = Field(0, ge=0)
+    quantize_groups: int = Field(1, ge=1)
+    quantize_verbose: bool = False
+    quantization_type: str = "symmetric"      # symmetric | asymmetric
+    rounding: str = "nearest"                 # nearest | stochastic
+    quantize_weight_in_forward: bool = False
+    fp16_mixed_quantize: FP16MixedQuantize = Field(default_factory=FP16MixedQuantize)
+
+
+class ActivationQuantizeShared(ConfigModel):
+    enabled: bool = False
+    quantization_type: str = "symmetric"
+    range_calibration: str = "dynamic"        # dynamic | static
+    schedule_offset: int = Field(1000, ge=0)
+
+
+class PruningShared(ConfigModel):
+    enabled: bool = False
+    method: str = "l1"                        # l1 | topk
+    schedule_offset: int = Field(1000, ge=0)
+
+
+class QuantizeGroup(ConfigModel):
+    """One ``different_groups`` entry: which params, start→target bits, anneal period."""
+    start_bits: int = Field(8, ge=1)
+    target_bits: int = Field(8, ge=1)
+    quantization_period: int = Field(1, ge=1)
+    modules: List[str] = Field(default_factory=lambda: ["*"])
+    related_modules: Optional[List[str]] = None
+
+
+class PruneGroup(ConfigModel):
+    dense_ratio: float = Field(0.5, gt=0, le=1)
+    modules: List[str] = Field(default_factory=lambda: ["*"])
+    related_modules: Optional[List[str]] = None
+    num_heads: Optional[int] = None           # head pruning only
+
+
+class QuantizeSection(ConfigModel):
+    shared_parameters: WeightQuantizeShared = Field(
+        default_factory=WeightQuantizeShared)
+    different_groups: Dict[str, QuantizeGroup] = Field(default_factory=dict)
+
+
+class ActQuantizeSection(ConfigModel):
+    shared_parameters: ActivationQuantizeShared = Field(
+        default_factory=ActivationQuantizeShared)
+    different_groups: Dict[str, QuantizeGroup] = Field(default_factory=dict)
+
+
+class PruneSection(ConfigModel):
+    shared_parameters: PruningShared = Field(default_factory=PruningShared)
+    different_groups: Dict[str, PruneGroup] = Field(default_factory=dict)
+
+
+class LayerReduction(ConfigModel):
+    """Reference ``get_layer_reduction``: distill a deep teacher into a shallower
+    student by keeping selected teacher layers."""
+    enabled: bool = False
+    keep_number_layer: Optional[int] = None
+    module_name_prefix: str = ""
+    teacher_layer: List[int] = Field(default_factory=list)
+    other_module_name: List[str] = Field(default_factory=list)
+
+
+def _normalize_groups(section: dict) -> dict:
+    """Reference nests group params under ``"params"``; flatten to our model."""
+    out = dict(section)
+    groups = {}
+    for name, g in section.get("different_groups", {}).items():
+        flat = dict(g.get("params", {}))
+        if "modules" in g:
+            flat["modules"] = g["modules"]
+        if "related_modules" in g:
+            flat["related_modules"] = g["related_modules"]
+        groups[name] = flat
+    out["different_groups"] = groups
+    return out
+
+
+class CompressionConfig:
+    """Parsed ``compression_training`` block."""
+
+    def __init__(self, param_dict: Optional[dict] = None):
+        pd = dict(param_dict or {})
+        self.layer_reduction = LayerReduction(
+            **({"enabled": True, **pd["layer_reduction"]}
+               if isinstance(pd.get("layer_reduction"), dict) else {}))
+        self.weight_quantization = QuantizeSection(
+            **_normalize_groups(pd.get("weight_quantization", {})))
+        self.activation_quantization = ActQuantizeSection(
+            **_normalize_groups(pd.get("activation_quantization", {})))
+        self.sparse_pruning = PruneSection(
+            **_normalize_groups(pd.get("sparse_pruning", {})))
+        self.row_pruning = PruneSection(
+            **_normalize_groups(pd.get("row_pruning", {})))
+        self.head_pruning = PruneSection(
+            **_normalize_groups(pd.get("head_pruning", {})))
+        self.channel_pruning = PruneSection(
+            **_normalize_groups(pd.get("channel_pruning", {})))
+        if self.weight_quantization.shared_parameters.enabled and \
+                not self.weight_quantization.different_groups:
+            raise ValueError("weight_quantization enabled requires different_groups")
+
+    @property
+    def any_enabled(self) -> bool:
+        return (self.weight_quantization.shared_parameters.enabled or
+                self.activation_quantization.shared_parameters.enabled or
+                self.sparse_pruning.shared_parameters.enabled or
+                self.row_pruning.shared_parameters.enabled or
+                self.head_pruning.shared_parameters.enabled or
+                self.channel_pruning.shared_parameters.enabled or
+                self.layer_reduction.enabled)

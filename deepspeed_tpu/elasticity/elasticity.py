@@ -67,7 +67,7 @@ def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[in
     """Pick the candidate with the most valid device counts; ties break toward the
     larger (or smaller) batch (reference :97)."""
     best_count = 0
-    best_valid: Optional[List[int]] = None
+    best_valid: List[int] = []
     best_batch = int(min(micro_batches))
     for batch_size in candidate_batch_sizes:
         valid = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
@@ -78,6 +78,11 @@ def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[in
             best_count = len(valid)
             best_valid = valid
             best_batch = batch_size
+    if not best_valid:
+        raise ElasticityError(
+            f"No device count in [{min_gpus}, {max_gpus}] is compatible with "
+            f"micro batches {micro_batches} under any candidate batch size "
+            f"{candidate_batch_sizes}")
     return best_batch, best_valid
 
 

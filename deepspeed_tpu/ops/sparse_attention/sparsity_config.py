@@ -145,8 +145,10 @@ class VariableSparsityConfig(SparsityConfig):
                  seed: int = 0):
         super().__init__(num_heads, block, different_layout_per_head)
         self.num_random_blocks = num_random_blocks
-        self.local_window_blocks = local_window_blocks or [4]
-        self.global_block_indices = global_block_indices or [0]
+        self.local_window_blocks = (local_window_blocks
+                                    if local_window_blocks is not None else [4])
+        self.global_block_indices = (global_block_indices
+                                     if global_block_indices is not None else [0])
         if global_block_end_indices is not None:
             if len(self.global_block_indices) != len(global_block_end_indices):
                 raise ValueError("global start/end index lists must match in length")
@@ -177,7 +179,6 @@ class VariableSparsityConfig(SparsityConfig):
         n = layout.shape[1]
         starts, ends = [], []
         pos = 0
-        size = self.local_window_blocks[-1]
         for size in self.local_window_blocks:
             starts.append(pos)
             ends.append(min(pos + size, n))
@@ -294,7 +295,8 @@ class BSLongformerSparsityConfig(SparsityConfig):
                  attention: str = "bidirectional"):
         super().__init__(num_heads, block, different_layout_per_head)
         self.num_sliding_window_blocks = num_sliding_window_blocks
-        self.global_block_indices = global_block_indices or [0]
+        self.global_block_indices = (global_block_indices
+                                     if global_block_indices is not None else [0])
         if global_block_end_indices is not None:
             if len(self.global_block_indices) != len(global_block_end_indices):
                 raise ValueError("global start/end index lists must match in length")

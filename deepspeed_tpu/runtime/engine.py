@@ -162,6 +162,7 @@ class DeepSpeedEngine:
             "min_coeff": params.pop("min_coeff", 0.01),
         }
         params.pop("torch_adam", None)
+        out["extra"] = params  # optimizer-specific keys (freeze_step, ...)
         self._opt_cfg_cache = out
         return out
 
@@ -192,6 +193,20 @@ class DeepSpeedEngine:
             return fused_lamb(betas=oc["betas"], eps=oc["eps"],
                               weight_decay=oc["weight_decay"],
                               max_coeff=oc["max_coeff"], min_coeff=oc["min_coeff"])
+        if name in ("onebitadam", "zerooneadam"):
+            from .fp16.onebit.adam import onebit_adam, zero_one_adam
+            extra = oc["extra"]
+            if name == "onebitadam":
+                return onebit_adam(betas=oc["betas"], eps=oc["eps"],
+                                   weight_decay=oc["weight_decay"],
+                                   freeze_step=extra.get("freeze_step", 100),
+                                   adam_w_mode=oc["adam_w_mode"])
+            return zero_one_adam(
+                betas=oc["betas"], eps=oc["eps"],
+                weight_decay=oc["weight_decay"],
+                var_freeze_step=extra.get("var_freeze_step", 100000),
+                var_update_scaler=extra.get("var_update_scaler", 16),
+                adam_w_mode=oc["adam_w_mode"])
         if name == "adagrad":
             return adagrad(eps=oc["eps"], weight_decay=oc["weight_decay"])
         raise ValueError(f"Unknown optimizer {name!r} "
@@ -259,6 +274,15 @@ class DeepSpeedEngine:
         self._base_rng = rng
 
         abstract_params = jax.eval_shape(self.module.init_fn, rng)
+        # compression scheduler (reference init_compression wiring in engine __init__)
+        self._compression = None
+        if self._config.compression_config:
+            from ..compression.compress import init_compression
+            sched = init_compression(abstract_params,
+                                     {"compression_training":
+                                      self._config.compression_config})
+            if sched.active:
+                self._compression = sched
         persist = self._config.zero_config.param_persistence_threshold
         self._param_spec_tree = param_specs(abstract_params, mesh, self.zero_stage,
                                             base_specs=self.module.param_specs,
@@ -313,11 +337,15 @@ class DeepSpeedEngine:
         )
 
     # --------------------------------------------------------------- internals
-    def _loss_and_scaled_grads(self, params, scale, batch, rng):
-        """value_and_grad in compute dtype against fp32 masters; loss scaled pre-diff."""
+    def _loss_and_scaled_grads(self, params, scale, batch, rng, step=None):
+        """value_and_grad in compute dtype against fp32 masters; loss scaled pre-diff.
+        ``step`` (traced) gates the compression scheduler's QAT transforms."""
 
         def f(p):
-            loss = self.module.loss_fn(tree_cast(p, self.compute_dtype), batch, rng)
+            p = tree_cast(p, self.compute_dtype)
+            if self._compression is not None and step is not None:
+                p = self._compression.qat(p, step)
+            loss = self.module.loss_fn(p, batch, rng)
             if isinstance(loss, tuple):
                 loss = loss[0]
             return loss * scale.astype(loss.dtype), loss
@@ -396,7 +424,8 @@ class DeepSpeedEngine:
                 mb, idx = xs
                 rng = jax.random.fold_in(step_rng, idx)
                 loss, grads = self._loss_and_scaled_grads(
-                    state.params, state.scaler.cur_scale, mb, rng)
+                    state.params, state.scaler.cur_scale, mb, rng,
+                    step=state.global_step)
                 acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                 acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
                 return acc, loss
@@ -432,8 +461,9 @@ class DeepSpeedEngine:
         """Eager-compatible forward/backward/step path (reference API)."""
         grad_shardings = self._grad_shardings
 
-        def fwd_bwd(params, scale, batch, rng):
-            loss, grads = self._loss_and_scaled_grads(params, scale, batch, rng)
+        def fwd_bwd(params, scale, batch, rng, step):
+            loss, grads = self._loss_and_scaled_grads(params, scale, batch, rng,
+                                                      step=step)
             # fp32 accumulation regardless of param dtype (the fused path's acc0 is fp32;
             # bf16/fp16 accumulation across microbatches would drop small contributions)
             grads = tree_cast(grads, jnp.float32)
@@ -599,8 +629,9 @@ class DeepSpeedEngine:
         gb = self._globalize(batch)
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_rng, self.state.global_step), self.micro_steps)
-        loss, grads = self._fns["fwd_bwd"](self.state.params, self.state.scaler.cur_scale,
-                                           gb, rng)
+        loss, grads = self._fns["fwd_bwd"](self.state.params,
+                                           self.state.scaler.cur_scale,
+                                           gb, rng, self.state.global_step)
         self._cached_grads = grads
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss

@@ -1,0 +1,2 @@
+"""1-bit optimizers (reference deepspeed/runtime/fp16/onebit)."""
+from .adam import onebit_adam, zero_one_adam
