@@ -1,0 +1,5 @@
+"""Autotuning (reference ``deepspeed/autotuning``): search micro-batch/ZeRO/remat
+configs by short in-process measured trials."""
+from .autotuner import Autotuner, apply_overrides
+from .config import AutotuningConfig
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner, make_tuner

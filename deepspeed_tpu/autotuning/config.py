@@ -1,0 +1,41 @@
+"""Autotuning configuration.
+
+Behavioural equivalent of reference ``deepspeed/autotuning/config.py``
+(``DeepSpeedAutotuningConfig``): same JSON keys under ``"autotuning"``.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import Field
+
+from ..config.config_utils import ConfigModel
+
+METRIC_LATENCY = "latency"
+METRIC_THROUGHPUT = "throughput"
+METRIC_FLOPS = "flops"
+
+TUNER_GRIDSEARCH = "gridsearch"
+TUNER_RANDOM = "random"
+TUNER_MODELBASED = "model_based"
+
+
+class AutotuningConfig(ConfigModel):
+    enabled: bool = False
+    fast: bool = True                     # micro-batch-only sweep first
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = True
+    start_profile_step: int = Field(3, ge=0)
+    end_profile_step: int = Field(5, gt=0)
+    metric: str = METRIC_THROUGHPUT       # latency | throughput | flops
+    tuner_type: str = TUNER_GRIDSEARCH
+    tuner_early_stopping: int = Field(5, gt=0)
+    tuner_num_trials: int = Field(50, gt=0)
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = Field(1, gt=0)
+    max_train_micro_batch_size_per_gpu: Optional[int] = None
+    min_train_micro_batch_size_per_gpu: int = Field(1, gt=0)
+    num_tuning_micro_batch_sizes: int = Field(3, gt=0)
+    mp_size: int = Field(1, gt=0)
+    # tuning-space overrides: e.g. {"zero_optimization": {"stage": [0, 1, 3]}}
+    tuning_space: Dict[str, Any] = Field(default_factory=dict)

@@ -1,0 +1,135 @@
+"""Experiment tuners: the order in which candidate configs are tried.
+
+Behavioural equivalent of reference ``deepspeed/autotuning/tuner/``
+(``GridSearchTuner``, ``RandomTuner``, ``ModelBasedTuner`` — ``base_tuner.py:15``):
+each consumes a list of candidate experiments and yields them in its own order;
+``tune()`` supports early stopping after ``early_stopping`` non-improving trials.
+
+The model-based tuner replaces the reference's XGBoost cost model with an on-line
+nearest-neighbour score over the numeric features of already-measured configs —
+dependency-free and adequate for the small spaces the autotuner explores.
+"""
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+def _features(exp: Dict) -> List[float]:
+    out = []
+    for key in sorted(exp):
+        v = exp[key]
+        if isinstance(v, bool):
+            out.append(float(v))
+        elif isinstance(v, (int, float)):
+            out.append(float(v))
+    return out
+
+
+class BaseTuner:
+    """Iterate experiments, measure, keep the best (reference ``base_tuner.py``)."""
+
+    def __init__(self, exps: List[Dict], metric: str = "throughput"):
+        self.all_exps = list(exps)
+        self.metric = metric
+        self.best_exp: Optional[Dict] = None
+        self.best_metric_val: float = float("-inf")
+        self.records: List[Tuple[Dict, float]] = []
+
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        return bool(self.all_exps)
+
+    def update(self):
+        """Hook after each measured batch (model refit etc.)."""
+
+    def tune(self, measure: Callable[[Dict], Optional[float]],
+             sample_size: int = 1, n_trials: int = 1000,
+             early_stopping: Optional[int] = None) -> Optional[Dict]:
+        """Run up to ``n_trials`` experiments; ``measure`` returns the metric value
+        (higher is better) or None for an infeasible config."""
+        tried = 0
+        since_best = 0
+        while self.has_next() and tried < n_trials:
+            for exp in self.next_batch(sample_size):
+                val = measure(exp)
+                tried += 1
+                if val is not None:
+                    self.records.append((exp, val))
+                    if val > self.best_metric_val:
+                        self.best_metric_val = val
+                        self.best_exp = exp
+                        since_best = 0
+                        continue
+                since_best += 1
+                if early_stopping and since_best >= early_stopping:
+                    return self.best_exp
+            self.update()
+        return self.best_exp
+
+
+class GridSearchTuner(BaseTuner):
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        batch = self.all_exps[:sample_size]
+        self.all_exps = self.all_exps[sample_size:]
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, exps, metric="throughput", seed: int = 0):
+        super().__init__(exps, metric)
+        self._rng = random.Random(seed)
+
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        n = min(sample_size, len(self.all_exps))
+        picks = self._rng.sample(range(len(self.all_exps)), n)
+        batch = [self.all_exps[i] for i in picks]
+        for i in sorted(picks, reverse=True):
+            self.all_exps.pop(i)
+        return batch
+
+
+class ModelBasedTuner(BaseTuner):
+    """Explore-then-exploit: after a few random probes, prefer the unmeasured config
+    whose features are closest to the best measured ones (reference
+    ``model_based_tuner.py`` capability with a KNN score instead of XGBoost)."""
+
+    def __init__(self, exps, metric="throughput", warmup: int = 2, seed: int = 0):
+        super().__init__(exps, metric)
+        self.warmup = warmup
+        self._rng = random.Random(seed)
+
+    def _score(self, exp: Dict) -> float:
+        if not self.records:
+            return 0.0
+        f = _features(exp)
+        num = den = 0.0
+        for rec_exp, val in self.records:
+            rf = _features(rec_exp)
+            d = sum((a - b) ** 2 for a, b in zip(f, rf)) ** 0.5
+            w = 1.0 / (1.0 + d)
+            num += w * val
+            den += w
+        return num / den
+
+    def next_batch(self, sample_size: int) -> List[Dict]:
+        batch = []
+        for _ in range(min(sample_size, len(self.all_exps))):
+            if len(self.records) < self.warmup:
+                idx = self._rng.randrange(len(self.all_exps))
+            else:
+                idx = max(range(len(self.all_exps)),
+                          key=lambda i: self._score(self.all_exps[i]))
+            batch.append(self.all_exps.pop(idx))
+        return batch
+
+
+def make_tuner(tuner_type: str, exps: List[Dict], metric: str) -> BaseTuner:
+    if tuner_type == "gridsearch":
+        return GridSearchTuner(exps, metric)
+    if tuner_type == "random":
+        return RandomTuner(exps, metric)
+    if tuner_type == "model_based":
+        return ModelBasedTuner(exps, metric)
+    raise ValueError(f"unknown tuner_type {tuner_type!r}")

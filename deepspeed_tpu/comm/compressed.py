@@ -33,19 +33,28 @@ def _unpack_bits(packed: jnp.ndarray, n: int) -> jnp.ndarray:
     return bits.reshape(-1)[:n].astype(bool)
 
 
+def sign_compress(x: jnp.ndarray,
+                  error: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Error-compensated 1-bit compression (unpacked): returns
+    ``(compressed, new_error)`` with ``compressed + new_error == x + error`` exactly.
+    Shared by the 1-bit optimizers (momentum compression) and the wire collective."""
+    c = x + error
+    scale = jnp.mean(jnp.abs(c))
+    compressed = jnp.where(c >= 0, scale, -scale)
+    return compressed, c - compressed
+
+
 def compress_signs(x: jnp.ndarray,
                    error: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray,
                                                 jnp.ndarray]:
-    """Error-compensated 1-bit compression of a flat fp32 tensor.
-
-    Returns ``(packed_signs uint8, scale, new_error)`` with
+    """Error-compensated 1-bit compression of a flat fp32 tensor, bit-packed for the
+    wire. Returns ``(packed_signs uint8, scale, new_error)`` with
     ``decompress(packed, scale) + new_error == x + error`` exactly.
     """
     c = x + error
     scale = jnp.mean(jnp.abs(c))
     signs = c >= 0
-    compressed = jnp.where(signs, scale, -scale)
-    new_error = c - compressed
+    new_error = c - jnp.where(signs, scale, -scale)
     return _pack_bits(signs), scale, new_error
 
 

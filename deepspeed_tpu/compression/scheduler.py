@@ -13,6 +13,7 @@ scheduler builds ONE jit-safe transform over the param pytree; step-dependent ga
 
 import fnmatch
 import re
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -63,6 +64,10 @@ class CompressionScheduler:
             ("head_pruning", config.head_pruning),
             ("channel_pruning", config.channel_pruning),
         ]
+        # weight-matrix rank per pruning kind; +1 permitted for stacked bodies
+        # (leading layer dim), handled by vmap at apply time
+        base_ndim = {"sparse_pruning": 2, "row_pruning": 2, "head_pruning": 2,
+                     "channel_pruning": 4}
         for path, leaf in flat:
             pstr = _path_str(path)
             last = pstr.rsplit(".", 1)[-1].lower()
@@ -74,6 +79,17 @@ class CompressionScheduler:
                 continue
             for kind, section in sections:
                 if not section.shared_parameters.enabled:
+                    continue
+                if kind in base_ndim and \
+                        section.shared_parameters.method not in ("l1",):
+                    raise NotImplementedError(
+                        f"{kind} method {section.shared_parameters.method!r}: only "
+                        "'l1' (magnitude) is implemented; the reference's learnable "
+                        "'topk' scores are not")
+                if kind in base_ndim and \
+                        leaf.ndim not in (base_ndim[kind], base_ndim[kind] + 1):
+                    log_dist(f"compression: skipping {kind} for {pstr} "
+                             f"(ndim {leaf.ndim} unsupported)", ranks=[0])
                     continue
                 for group in section.different_groups.values():
                     if _matches(pstr, group.modules):
@@ -100,6 +116,26 @@ class CompressionScheduler:
         bits = jnp.float32(start_bits) * (0.5 ** halvings)
         return jnp.maximum(bits, jnp.float32(target_bits))
 
+    # ------------------------------------------------------------------ pruning
+    @staticmethod
+    def _prune_mask(kind: str, w, group, sp):
+        """Mask for one leaf; stacked-body leaves (one extra leading layer dim) get
+        the per-layer mask vmapped over that dim."""
+        base_ndim = 4 if kind == "channel_pruning" else 2
+        if kind == "sparse_pruning":
+            fn = lambda x: sparse_mask(x, group.dense_ratio, sp.method)
+        elif kind == "row_pruning":
+            fn = lambda x: row_mask(x, group.dense_ratio, sp.method)
+        elif kind == "head_pruning":
+            assert group.num_heads, "head_pruning groups need num_heads"
+            fn = lambda x: head_mask(x, group.dense_ratio, group.num_heads,
+                                     sp.method)
+        else:
+            fn = lambda x: channel_mask(x, group.dense_ratio, sp.method)
+        if w.ndim == base_ndim + 1:
+            return jax.vmap(fn)(w)
+        return fn(w)
+
     # ------------------------------------------------------------------ apply
     def qat(self, params: Any, step) -> Any:
         """Apply active compression to matched leaves inside the train step.
@@ -124,9 +160,11 @@ class CompressionScheduler:
                                                group.quantization_period,
                                                sp.schedule_offset)
                     stochastic = sp.rounding == "stochastic"
+                    # crc32, not hash(): reproducible across processes/resumes
                     rng = (jax.random.fold_in(
                         jax.random.fold_in(jax.random.PRNGKey(0x51A7), step),
-                        hash(pstr) % (2 ** 31)) if stochastic else None)
+                        zlib.crc32(pstr.encode()) & 0x7FFFFFFF)
+                        if stochastic else None)
                     q = quantize_dequantize(out, bits, sp.quantization_type,
                                             groups=sp.quantize_groups,
                                             stochastic=stochastic, rng=rng)
@@ -134,17 +172,7 @@ class CompressionScheduler:
                 else:
                     section = getattr(self.config, kind)
                     sp = section.shared_parameters
-                    if kind == "sparse_pruning":
-                        mask = sparse_mask(out, group.dense_ratio, sp.method)
-                    elif kind == "row_pruning":
-                        mask = row_mask(out, group.dense_ratio, sp.method)
-                    elif kind == "head_pruning":
-                        assert group.num_heads, \
-                            "head_pruning groups need num_heads"
-                        mask = head_mask(out, group.dense_ratio, group.num_heads,
-                                         sp.method)
-                    else:
-                        mask = channel_mask(out, group.dense_ratio, sp.method)
+                    mask = self._prune_mask(kind, out, group, sp)
                     out = jnp.where(step >= sp.schedule_offset, out * mask, out)
             return out
 
@@ -159,12 +187,7 @@ class CompressionScheduler:
         for path, leaf in flat:
             pstr = _path_str(path)
             for kind, group in self.plans.get(pstr, []):
-                if kind == "sparse_pruning":
-                    out[pstr] = sparse_mask(leaf, group.dense_ratio)
-                elif kind == "row_pruning":
-                    out[pstr] = row_mask(leaf, group.dense_ratio)
-                elif kind == "head_pruning":
-                    out[pstr] = head_mask(leaf, group.dense_ratio, group.num_heads)
-                elif kind == "channel_pruning":
-                    out[pstr] = channel_mask(leaf, group.dense_ratio)
+                if kind != "weight_quantization":
+                    sp = getattr(self.config, kind).shared_parameters
+                    out[pstr] = self._prune_mask(kind, leaf, group, sp)
         return out

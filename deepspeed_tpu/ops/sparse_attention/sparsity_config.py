@@ -145,6 +145,9 @@ class VariableSparsityConfig(SparsityConfig):
                  seed: int = 0):
         super().__init__(num_heads, block, different_layout_per_head)
         self.num_random_blocks = num_random_blocks
+        if local_window_blocks is not None and not local_window_blocks:
+            raise ValueError("local_window_blocks must be non-empty "
+                             "(every row needs a local window size)")
         self.local_window_blocks = (local_window_blocks
                                     if local_window_blocks is not None else [4])
         self.global_block_indices = (global_block_indices

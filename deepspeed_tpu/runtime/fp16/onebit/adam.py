@@ -35,10 +35,10 @@ class OnebitAdamState(NamedTuple):
 
 
 def _sign_compress(m, error):
-    c = m + error
-    scale = jnp.mean(jnp.abs(c))
-    compressed = jnp.where(c >= 0, scale, -scale)
-    return compressed, c - compressed
+    """Error-compensated 1-bit form — the unpacked core of
+    ``comm.compressed.compress_signs`` (which adds the wire bit-packing)."""
+    from ....comm.compressed import sign_compress
+    return sign_compress(m, error)
 
 
 def onebit_adam(betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
@@ -104,12 +104,16 @@ def zero_one_adam(betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                   weight_decay: float = 0.0,
                   var_freeze_step: int = 100000,
                   var_update_scaler: int = 16,
-                  local_step_scaler: int = 32678,
-                  local_step_clipper: int = 16,
                   adam_w_mode: bool = False) -> Optimizer:
     """0/1 Adam (reference ``zoadam.py:ZeroOneAdam``): variance refreshed only at
     exponentially-spaced intervals (``var_update_scaler``) until ``var_freeze_step``,
-    momentum always 1-bit-compressed with error feedback."""
+    momentum always 1-bit-compressed with error feedback.
+
+    The reference's ``local_step_scaler``/``local_step_clipper`` knobs schedule how
+    often workers SYNC at all (local-update mode over the wire); in this
+    single-controller in-graph optimizer every step is globally consistent, so those
+    knobs have no meaning and are deliberately not accepted.
+    """
     beta1, beta2 = betas
 
     def init(params):

@@ -55,7 +55,8 @@ class PipelineEngine(DeepSpeedEngine):
         def train_step(state: TrainState, batch, lr):
             rng = jax.random.fold_in(self._base_rng, state.global_step)
             loss, grads = self._loss_and_scaled_grads(
-                state.params, state.scaler.cur_scale, batch, rng)
+                state.params, state.scaler.cur_scale, batch, rng,
+                step=state.global_step)
             grads = jax.lax.with_sharding_constraint(grads, self._grad_shardings)
             new_state, metrics = self._apply_update(state, grads, lr, 1)
             metrics["loss"] = loss
