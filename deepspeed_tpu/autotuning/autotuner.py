@@ -33,9 +33,10 @@ DEFAULT_TUNING_SPACE = {
 
 class Autotuner:
     """``engine_factory(overrides: dict) -> engine`` builds a fresh engine with the
-    candidate config merged in; ``batch_factory(micro_batch) -> batch`` supplies a
-    matching batch. The separation keeps the tuner model-agnostic (reference passes
-    user script args instead)."""
+    candidate config merged in; ``batch_factory(global_batch_size) -> batch`` supplies
+    one full train batch of that size (``engine.train_batch`` splits it into gas
+    microbatches itself). The separation keeps the tuner model-agnostic (reference
+    passes user script args instead)."""
 
     def __init__(self, base_config: Dict, engine_factory: Callable[[Dict], Any],
                  batch_factory: Callable[[int], Any],
@@ -120,9 +121,10 @@ class Autotuner:
                             f"{est/1e9:.2f}GB > HBM {self.hbm_bytes/1e9:.2f}GB")
                 self.records.append({"exp": overrides, "status": "pruned"})
                 return None
+        metric_key = {"latency": "latency_s", "throughput": "throughput",
+                      "flops": "flops"}[self.cfg.metric]
         try:
             engine = self.engine_factory(overrides)
-            micro = engine.train_micro_batch_size_per_gpu()
             batch = self.batch_factory(engine.train_batch_size())
             warmup = self.cfg.start_profile_step
             steps = self.cfg.end_profile_step
@@ -143,8 +145,6 @@ class Autotuner:
             log_dist(f"[autotuner] {overrides} -> {samples_per_sec:.1f} samples/s "
                      f"({dt*1e3:.1f} ms/step)", ranks=[0])
             del engine
-            metric_key = {"latency": "latency_s", "throughput": "throughput",
-                          "flops": "flops"}[self.cfg.metric]
             val = record[metric_key]
             return -val if self.cfg.metric == "latency" else val
         except Exception as e:  # XLA RESOURCE_EXHAUSTED and friends

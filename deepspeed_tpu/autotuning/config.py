@@ -6,7 +6,7 @@ Behavioural equivalent of reference ``deepspeed/autotuning/config.py``
 
 from typing import Any, Dict, List, Optional
 
-from pydantic import Field
+from pydantic import Field, field_validator, model_validator
 
 from ..config.config_utils import ConfigModel
 
@@ -37,5 +37,22 @@ class AutotuningConfig(ConfigModel):
     min_train_micro_batch_size_per_gpu: int = Field(1, gt=0)
     num_tuning_micro_batch_sizes: int = Field(3, gt=0)
     mp_size: int = Field(1, gt=0)
-    # tuning-space overrides: e.g. {"zero_optimization": {"stage": [0, 1, 3]}}
+    # tuning-space overrides with DOTTED flat keys mapping to candidate value lists,
+    # e.g. {"zero_optimization.stage": [0, 1, 3]}
     tuning_space: Dict[str, Any] = Field(default_factory=dict)
+
+    @field_validator("metric")
+    @classmethod
+    def _valid_metric(cls, v):
+        if v not in (METRIC_LATENCY, METRIC_THROUGHPUT, METRIC_FLOPS):
+            raise ValueError(f"autotuning metric {v!r} must be one of "
+                             f"{METRIC_LATENCY}/{METRIC_THROUGHPUT}/{METRIC_FLOPS}")
+        return v
+
+    @model_validator(mode="after")
+    def _profile_window(self):
+        if self.end_profile_step <= self.start_profile_step:
+            raise ValueError(
+                f"end_profile_step ({self.end_profile_step}) must be > "
+                f"start_profile_step ({self.start_profile_step})")
+        return self

@@ -52,6 +52,8 @@ def get_attention_impl(name: str = "xla"):
     (on CPU the Pallas kernel runs in interpreter mode, which is orders of magnitude slower —
     fine for kernel unit tests, wrong as a default).
     """
+    if callable(name):
+        return name  # pre-bound impl (e.g. make_sparse_attention_impl(config))
     if name == "auto":
         name = "flash" if jax.default_backend() == "tpu" else "xla"
     if name == "xla":
