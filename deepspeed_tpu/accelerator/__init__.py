@@ -1,0 +1,3 @@
+"""Accelerator abstraction (reference ``deepspeed/accelerator``)."""
+from .real_accelerator import get_accelerator, set_accelerator
+from .tpu_accelerator import TPU_Accelerator

@@ -1,0 +1,28 @@
+"""Accelerator resolution.
+
+Behavioural equivalent of reference ``deepspeed/accelerator/real_accelerator.py``
+(``get_accelerator``): one process-global accelerator instance, overridable for tests
+(``set_accelerator``) or via ``DS_ACCELERATOR`` env.
+"""
+
+import os
+from typing import Optional
+
+_accelerator = None
+
+
+def get_accelerator():
+    global _accelerator
+    if _accelerator is None:
+        name = os.environ.get("DS_ACCELERATOR", "tpu")
+        if name != "tpu":
+            raise ValueError(f"DS_ACCELERATOR={name!r}: only 'tpu' is available "
+                             "in this framework")
+        from .tpu_accelerator import TPU_Accelerator
+        _accelerator = TPU_Accelerator()
+    return _accelerator
+
+
+def set_accelerator(accel) -> None:
+    global _accelerator
+    _accelerator = accel

@@ -1,0 +1,109 @@
+"""TPU accelerator backend.
+
+Behavioural equivalent of reference ``deepspeed/accelerator/abstract_accelerator.py:7``
+(``DeepSpeedAccelerator`` ABC) + ``cuda_accelerator.py``: the device-portability shim the
+rest of the framework queries instead of touching a backend directly. Under JAX most of
+the reference surface (streams, rng-state plumbing, pinned-memory allocators) is owned by
+the runtime; those members keep their names and behave as the no-op/default the XLA
+programming model implies, so reference-shaped code keeps running.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class TPU_Accelerator:
+    def __init__(self):
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+
+    # ------------------------------------------------------------ identity
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        devs = jax.local_devices()
+        return devs[device_index or 0]
+
+    def current_device(self) -> int:
+        return 0
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    def device_count(self) -> int:
+        return jax.device_count()
+
+    def is_available(self) -> bool:
+        try:
+            return any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            return False
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    # ------------------------------------------------------------ sync / rng
+    def synchronize(self, device_index: Optional[int] = None):
+        """Block until dispatched work completes (reference ``synchronize``)."""
+        jax.effects_barrier()
+
+    def set_rng_state(self, new_state, device_index=None):
+        raise NotImplementedError(
+            "JAX rng is functional (threaded PRNG keys), not device state")
+
+    def get_rng_state(self, device_index=None):
+        raise NotImplementedError(
+            "JAX rng is functional (threaded PRNG keys), not device state")
+
+    def manual_seed(self, seed):  # engines thread PRNGKey(seed); accepted for compat
+        return None
+
+    # ------------------------------------------------------------ memory
+    def _stats(self, device_index=None) -> dict:
+        try:
+            return self.device(device_index).memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("peak_bytes_in_use", 0))
+
+    def total_memory(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index=None) -> int:
+        s = self._stats(device_index)
+        return int(s.get("bytes_limit", 0)) - int(s.get("bytes_in_use", 0))
+
+    def empty_cache(self):  # XLA owns the allocator; accepted for compat
+        return None
+
+    def reset_peak_memory_stats(self, device_index=None):
+        return None
+
+    # ------------------------------------------------------------ dtype support
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    # ------------------------------------------------------------ tensor helpers
+    def pin_memory(self, tensor: Any, align_bytes: int = 1) -> Any:
+        """Host arrays feed jax.device_put directly; returned unchanged."""
+        return tensor
+
+    def on_accelerator(self, tensor: Any) -> bool:
+        return isinstance(tensor, jax.Array) and \
+            tensor.devices() and next(iter(tensor.devices())).platform == "tpu"

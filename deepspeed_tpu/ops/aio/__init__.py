@@ -1,0 +1,2 @@
+"""Async I/O (reference deepspeed/ops/aio)."""
+from .aio_handle import AsyncIOHandle, aio_available

@@ -298,10 +298,24 @@ class DeepSpeedEngine:
             from .zero.offload import OffloadOptimizerTier
             oc = self._parse_optimizer_config()
             kind = "adagrad" if oc["name"] == "adagrad" else "adam"
+            off_cfg = self._config.zero_config.offload_optimizer
+            nvme_path = None
+            if off_cfg.device == "nvme":
+                if not off_cfg.nvme_path:
+                    raise ValueError(
+                        "offload_optimizer.device=nvme requires nvme_path")
+                if kind != "adam":
+                    raise ValueError("nvme offload supports adam/adamw only")
+                nvme_path = off_cfg.nvme_path
+            aio = self._config.aio_config
             self._offload_tier = OffloadOptimizerTier(
                 params, self._param_shardings, self.compute_dtype, kind=kind,
                 betas=oc["betas"], eps=oc["eps"], weight_decay=oc["weight_decay"],
-                adam_w_mode=oc["adam_w_mode"], bias_correction=oc["bias_correction"])
+                adam_w_mode=oc["adam_w_mode"], bias_correction=oc["bias_correction"],
+                nvme_path=nvme_path,
+                aio_config={"thread_count": aio.thread_count,
+                            "block_size": aio.block_size,
+                            "queue_depth": aio.queue_depth})
             del params
             params = self._offload_tier.initial_device_params()
             opt_state = ()

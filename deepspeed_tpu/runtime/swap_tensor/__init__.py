@@ -1,0 +1,2 @@
+"""NVMe swap tier (reference deepspeed/runtime/swap_tensor): see zero/offload.py _NVMeMomentStore + ops/aio."""
+from ..zero.offload import _NVMeMomentStore as NVMeMomentStore  # noqa: F401

@@ -30,17 +30,93 @@ from ...ops.adam.cpu_adam import DeepSpeedCPUAdam, adagrad_step, fp32_to_bf16, n
 from ...utils.logging import log_dist
 
 
+class _NVMeMomentStore:
+    """Adam moments on disk, double-buffered through the native aio handle.
+
+    Layout: one file per leaf under ``path`` holding m then v back-to-back (fp32).
+    ``adam_step_all`` pipelines: while leaf ``i`` runs the SIMD Adam on scratch buffer
+    ``i % 2``, leaf ``i+1``'s moments stream into buffer ``(i+1) % 2``.
+    """
+
+    def __init__(self, path: str, masters, aio_config: dict):
+        import os
+        from ...ops.aio.aio_handle import AsyncIOHandle, aio_available
+        if not aio_available():
+            raise RuntimeError("offload_optimizer.device=nvme requires the native "
+                               "aio op (C++ toolchain)")
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.handle = AsyncIOHandle(
+            thread_count=aio_config.get("thread_count", 1),
+            block_size=aio_config.get("block_size", 1 << 20),
+            queue_depth=aio_config.get("queue_depth", 8))
+        self.sizes = [int(m.size) for m in masters]
+        self._files = [os.path.join(path, f"moments_leaf{i}.bin")
+                       for i in range(len(masters))]
+        max_size = max(self.sizes)
+        self._scratch = [np.empty(2 * max_size, np.float32) for _ in range(2)]
+        # zero-init the on-disk moments THROUGH the scratch buffer: host RAM must
+        # never hold more than masters + 2 scratch (the point of this tier)
+        zeros = self._scratch[0]
+        zeros[:] = 0.0
+        for f, s in zip(self._files, self.sizes):
+            self.handle.sync_pwrite(zeros[:2 * s], f)
+
+    def adam_step_all(self, masters, grads, lr, step, betas, eps, weight_decay,
+                      adam_w_mode, bias_correction):
+        from ...ops.adam.cpu_adam import adam_step
+        n = len(masters)
+        buf = self._scratch
+        self.handle.async_pread(buf[0][:2 * self.sizes[0]], self._files[0])
+        self.handle.wait()
+        for i in range(n):
+            if i + 1 < n:  # overlap: next leaf's moments stream in during compute
+                self.handle.async_pread(buf[(i + 1) % 2][:2 * self.sizes[i + 1]],
+                                        self._files[i + 1])
+            s = self.sizes[i]
+            mv = buf[i % 2]
+            adam_step(masters[i], mv[:s], mv[s:2 * s], grads[i], lr,
+                      betas[0], betas[1], eps, weight_decay, adam_w_mode, step,
+                      bias_correction)
+            self.handle.async_pwrite(mv[:2 * s], self._files[i])
+            self.handle.wait()
+
+    # ------------------------------------------------------------------ checkpoint
+    def read_moments(self):
+        ms, vs = [], []
+        for i, s in enumerate(self.sizes):
+            mv = np.empty(2 * s, np.float32)
+            self.handle.sync_pread(mv, self._files[i])
+            ms.append(mv[:s].copy())
+            vs.append(mv[s:].copy())
+        return ms, vs
+
+    def write_moments(self, ms, vs):
+        for i, (m, v) in enumerate(zip(ms, vs)):
+            mv = np.concatenate([np.asarray(m, np.float32).reshape(-1),
+                                 np.asarray(v, np.float32).reshape(-1)])
+            self.handle.sync_pwrite(mv, self._files[i])
+
+
 class OffloadOptimizerTier:
     """Host fp32 masters + moments; device params in compute dtype.
 
     ``kind`` is "adam" (AdamW via ``adam_w_mode``) or "adagrad" — the two reference CPU
     optimizers (``ops/adam/cpu_adam.py``, ``ops/adagrad/cpu_adagrad.py``).
+
+    ``nvme_path`` adds the ZeRO-Infinity tier (reference
+    ``runtime/swap_tensor/partitioned_optimizer_swapper.py:35`` + ``csrc/aio``): Adam
+    moments live on disk, streamed through two double-buffered scratch arrays by the
+    native async-I/O handle — next leaf's read overlaps the current leaf's SIMD Adam —
+    so host RAM holds masters + 2 scratch buffers instead of masters + 2×params of
+    moments.
     """
 
     def __init__(self, params_device: Any, param_shardings: Any, compute_dtype,
                  kind: str = "adam", betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adam_w_mode: bool = True,
-                 bias_correction: bool = True):
+                 bias_correction: bool = True, nvme_path: Optional[str] = None,
+                 aio_config: Optional[dict] = None):
         leaves, self._treedef = jax.tree_util.tree_flatten(params_device)
         self._shardings = jax.tree_util.tree_leaves(
             param_shardings, is_leaf=lambda x: hasattr(x, "spec"))
@@ -55,7 +131,16 @@ class OffloadOptimizerTier:
         # jax-owned host memory — masters must be private writable buffers.
         self.masters: List[np.ndarray] = [
             np.array(l, dtype=np.float32, copy=True).reshape(-1) for l in leaves]
-        if kind == "adam":
+        self.nvme = None
+        if kind == "adam" and nvme_path is not None:
+            self.nvme = _NVMeMomentStore(nvme_path, self.masters,
+                                         aio_config or {})
+            self._adam_kwargs = dict(betas=betas, eps=eps,
+                                     weight_decay=weight_decay,
+                                     adam_w_mode=adam_w_mode,
+                                     bias_correction=bias_correction)
+            self.step_count = 0
+        elif kind == "adam":
             self.opt = DeepSpeedCPUAdam(self.masters, betas=betas, eps=eps,
                                         weight_decay=weight_decay,
                                         adamw_mode=adam_w_mode,
@@ -98,7 +183,11 @@ class OffloadOptimizerTier:
         for l in leaves:
             l.copy_to_host_async()
         grads = [np.asarray(l, dtype=np.float32).reshape(-1) for l in leaves]
-        if self.kind == "adam":
+        if self.nvme is not None:
+            self.step_count += 1
+            self.nvme.adam_step_all(self.masters, grads, lr, self.step_count,
+                                    **self._adam_kwargs)
+        elif self.kind == "adam":
             self.opt.step(grads, lr=lr)
         else:
             self.step_count += 1
@@ -120,7 +209,14 @@ class OffloadOptimizerTier:
         sd = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])
                           for i, m in enumerate(self.masters)},
               "shapes": shapes}
-        if self.kind == "adam":
+        if self.nvme is not None:
+            ms, vs = self.nvme.read_moments()
+            sd["m"] = {f"leaf{i}": m.reshape(self._shapes[i])
+                       for i, m in enumerate(ms)}
+            sd["v"] = {f"leaf{i}": v.reshape(self._shapes[i])
+                       for i, v in enumerate(vs)}
+            sd["step"] = np.int64(self.step_count)
+        elif self.kind == "adam":
             opt_sd = self.opt.state_dict()
             sd["m"] = {f"leaf{i}": m.reshape(self._shapes[i])
                        for i, m in enumerate(opt_sd["m"])}
@@ -137,7 +233,12 @@ class OffloadOptimizerTier:
         for i, m in enumerate(self.masters):
             np.copyto(m, np.asarray(sd["masters"][f"leaf{i}"],
                                     dtype=np.float32).reshape(-1))
-        if self.kind == "adam":
+        if self.nvme is not None:
+            self.step_count = int(sd["step"])
+            self.nvme.write_moments(
+                [np.asarray(sd["m"][f"leaf{i}"]) for i in range(len(self.masters))],
+                [np.asarray(sd["v"][f"leaf{i}"]) for i in range(len(self.masters))])
+        elif self.kind == "adam":
             self.opt.load_state_dict({
                 "step": int(sd["step"]),
                 "m": [np.asarray(sd["m"][f"leaf{i}"]) for i in range(len(self.masters))],

@@ -1,0 +1,64 @@
+"""Native async-I/O tests (reference ``tests/unit/ops/aio/test_aio.py``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio.aio_handle import AsyncIOHandle, aio_available
+
+pytestmark = pytest.mark.skipif(not aio_available(),
+                                reason="native aio op failed to build")
+
+
+def test_write_read_roundtrip(tmp_path):
+    h = AsyncIOHandle(thread_count=2)
+    data = np.random.default_rng(0).standard_normal(100_000).astype(np.float32)
+    path = str(tmp_path / "buf.bin")
+    h.sync_pwrite(data, path)
+    out = np.empty_like(data)
+    h.sync_pread(out, path)
+    np.testing.assert_array_equal(out, data)
+    h.close()
+
+
+def test_async_batch_overlap(tmp_path):
+    """Many in-flight ops across files complete under one wait()."""
+    h = AsyncIOHandle(thread_count=4)
+    bufs = [np.full(50_000, float(i), np.float32) for i in range(8)]
+    for i, b in enumerate(bufs):
+        h.async_pwrite(b, str(tmp_path / f"f{i}.bin"))
+    h.wait()
+    outs = [np.empty(50_000, np.float32) for _ in range(8)]
+    for i, o in enumerate(outs):
+        h.async_pread(o, str(tmp_path / f"f{i}.bin"))
+    h.wait()
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, float(i))
+    h.close()
+
+
+def test_offset_io(tmp_path):
+    h = AsyncIOHandle()
+    base = np.arange(1000, dtype=np.float32)
+    path = str(tmp_path / "off.bin")
+    h.sync_pwrite(base, path)
+    tail = np.empty(500, np.float32)
+    h.sync_pread(tail, path, offset=500 * 4)
+    np.testing.assert_array_equal(tail, base[500:])
+    # partial overwrite at offset
+    patch = np.full(100, -1.0, np.float32)
+    h.sync_pwrite(patch, path, offset=200 * 4)
+    full = np.empty(1000, np.float32)
+    h.sync_pread(full, path)
+    np.testing.assert_array_equal(full[200:300], -1.0)
+    np.testing.assert_array_equal(full[:200], base[:200])
+    h.close()
+
+
+def test_read_error_raises(tmp_path):
+    h = AsyncIOHandle()
+    buf = np.empty(10, np.float32)
+    with pytest.raises(OSError):
+        h.sync_pread(buf, str(tmp_path / "missing.bin"))
+    h.close()

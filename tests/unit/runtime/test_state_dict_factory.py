@@ -1,0 +1,108 @@
+"""Sharded state-dict loading + MP re-partition tests (reference
+``tests/unit/checkpoint`` state-dict territory)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.state_dict_factory import (ShardedStateDict,
+                                                      SDLoaderFactory,
+                                                      merge_mp_tensors,
+                                                      merge_qkv_tensors,
+                                                      split_mp_tensor,
+                                                      split_qkv_tensor)
+
+
+def _make_sharded_torch_ckpt(path, tensors, shards=2):
+    torch = pytest.importorskip("torch")
+    names = list(tensors)
+    per = (len(names) + shards - 1) // shards
+    weight_map = {}
+    for s in range(shards):
+        fname = f"pytorch_model-{s + 1:05d}-of-{shards:05d}.bin"
+        chunk = {n: torch.tensor(tensors[n]) for n in names[s * per:(s + 1) * per]}
+        torch.save(chunk, str(path / fname))
+        weight_map.update({n: fname for n in chunk})
+    (path / "pytorch_model.bin.index.json").write_text(
+        json.dumps({"metadata": {}, "weight_map": weight_map}))
+
+
+class TestShardedStateDict:
+    def _tensors(self):
+        rng = np.random.default_rng(0)
+        return {f"layer.{i}.w": rng.standard_normal((4, 4)).astype(np.float32)
+                for i in range(6)}
+
+    def test_lazy_sharded_load(self, tmp_path):
+        tensors = self._tensors()
+        _make_sharded_torch_ckpt(tmp_path, tensors, shards=3)
+        sd = ShardedStateDict(str(tmp_path))
+        assert sorted(sd.keys()) == sorted(tensors)
+        assert len(sd.shards()) == 3
+        np.testing.assert_allclose(sd["layer.3.w"], tensors["layer.3.w"])
+        # only the shard containing layer.3.w was materialised
+        assert len(sd._cache) == 1
+
+    def test_stream_releases_shards(self, tmp_path):
+        tensors = self._tensors()
+        _make_sharded_torch_ckpt(tmp_path, tensors, shards=3)
+        sd = ShardedStateDict(str(tmp_path))
+        seen = {}
+        for name, t in sd.stream():
+            seen[name] = t
+            assert len(sd._cache) <= 1  # never more than one shard resident
+        assert sorted(seen) == sorted(tensors)
+
+    def test_single_file(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        tensors = self._tensors()
+        torch.save({k: torch.tensor(v) for k, v in tensors.items()},
+                   str(tmp_path / "pytorch_model.bin"))
+        sd = ShardedStateDict(str(tmp_path))
+        np.testing.assert_allclose(sd["layer.0.w"], tensors["layer.0.w"])
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            ShardedStateDict(str(tmp_path))
+
+    def test_factory_dir(self, tmp_path):
+        tensors = self._tensors()
+        _make_sharded_torch_ckpt(tmp_path, tensors)
+        sd = SDLoaderFactory.get_sd_loader_json(str(tmp_path))
+        assert isinstance(sd, ShardedStateDict)
+
+
+class TestMPRepartition:
+    def test_merge_split_roundtrip(self):
+        t = np.arange(24, dtype=np.float32).reshape(6, 4)
+        parts = split_mp_tensor(t, 2, axis=0)
+        assert parts[0].shape == (3, 4)
+        np.testing.assert_array_equal(merge_mp_tensors(parts, axis=0), t)
+
+    def test_qkv_roundtrip(self):
+        """QKV interleaving preserved: split then merge reproduces the fused tensor."""
+        fused = np.arange(36, dtype=np.float32).reshape(12, 3)  # [q(4); k(4); v(4)]
+        parts = split_qkv_tensor(fused, 2, axis=0)
+        assert parts[0].shape == (6, 3)
+        # each part holds [q_i; k_i; v_i]
+        np.testing.assert_array_equal(parts[0][:2], fused[0:2])    # q_0
+        np.testing.assert_array_equal(parts[0][2:4], fused[4:6])   # k_0
+        np.testing.assert_array_equal(parts[0][4:6], fused[8:10])  # v_0
+        merged = merge_qkv_tensors(parts, axis=0)
+        np.testing.assert_array_equal(merged, fused)
+
+
+class TestAccelerator:
+    def test_shim_surface(self):
+        from deepspeed_tpu.accelerator import get_accelerator
+        acc = get_accelerator()
+        assert acc.device_count() >= 1
+        assert acc.device_name(2) == "tpu:2"
+        assert acc.is_bf16_supported()
+        assert acc.communication_backend_name() == "xla"
+        acc.synchronize()
+        assert acc.memory_allocated() >= 0
+        import jax.numpy as jnp
+        x = jnp.ones(4)
+        assert isinstance(acc.on_accelerator(x), bool)
