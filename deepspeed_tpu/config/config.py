@@ -227,6 +227,31 @@ class DeepSpeedConfig:
         self.eigenvalue: Dict = pd.get(C.EIGENVALUE, {})
         self.elasticity: Dict = pd.get(C.ELASTICITY, {})
         self.compression_config: Dict = pd.get(C.COMPRESSION_TRAINING, {})
+        # MoQ (reference "quantize_training" block, runtime/quantize.py): expressed as
+        # a weight-quantization compression schedule — one QAT mechanism serves both
+        qt = pd.get(C.QUANTIZE_TRAINING, {})
+        if qt.get("enabled", False):
+            if "weight_quantization" in self.compression_config:
+                raise DeepSpeedConfigError(
+                    "Set either quantize_training or "
+                    "compression_training.weight_quantization, not both")
+            start_bits = qt.get("quantize_bits", {}).get("start_bits", 16)
+            target_bits = qt.get("quantize_bits", {}).get("target_bits", 8)
+            algo = qt.get("quantize_algo", {}) or {}
+            self.compression_config = dict(self.compression_config)
+            self.compression_config["weight_quantization"] = {
+                "shared_parameters": {
+                    "enabled": True,
+                    "schedule_offset": qt.get("schedule_offset", 0),
+                    "quantize_groups": qt.get("quantize_groups", 1),
+                    "quantization_type": algo.get("q_type", "symmetric"),
+                    "rounding": algo.get("rounding", "nearest"),
+                },
+                "different_groups": {"moq": {"params": {
+                    "start_bits": start_bits, "target_bits": target_bits,
+                    "quantization_period": qt.get("quantize_period", 1000),
+                }}},
+            }
         self.data_efficiency_config: Dict = pd.get(C.DATA_EFFICIENCY, {})
         self.curriculum_params_legacy: Dict = pd.get(C.CURRICULUM_LEARNING_LEGACY, {})
         self.curriculum_enabled_legacy: bool = bool(

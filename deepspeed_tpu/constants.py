@@ -74,6 +74,7 @@ MONITOR_CSV = "csv_monitor"
 FLOPS_PROFILER = "flops_profiler"
 ELASTICITY = "elasticity"
 COMPRESSION_TRAINING = "compression_training"
+QUANTIZE_TRAINING = "quantize_training"
 DATA_EFFICIENCY = "data_efficiency"
 CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
 PIPELINE = "pipeline"

@@ -124,7 +124,8 @@ void* ds_aio_handle_new(int n_threads, int64_t block_size) {
 void ds_aio_handle_free(void* h) { delete static_cast<Handle*>(h); }
 
 int ds_aio_open(const char* path, int for_write) {
-  int flags = for_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+  // O_TRUNC: an overwrite must not leave stale tail bytes from a longer old file
+  int flags = for_write ? (O_WRONLY | O_CREAT | O_TRUNC) : O_RDONLY;
   return open(path, flags, 0644);
 }
 

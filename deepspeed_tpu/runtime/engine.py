@@ -123,6 +123,24 @@ class DeepSpeedEngine:
         self.monitor = self._configure_monitor()
         self.checkpoint_engine = make_checkpoint_engine(self._config.checkpoint_config)
         self.curriculum_scheduler = self._configure_curriculum()
+        pld_cfg = self._config.progressive_layer_drop
+        self.progressive_layer_drop = None
+        self._pld_in_loss = False
+        if pld_cfg.get("enabled", False):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
+            # theta reaches the compiled step only if the model opts in by accepting
+            # a pld_theta kwarg in its loss_fn (and applies layer_drop with it)
+            import inspect
+            self._pld_in_loss = "pld_theta" in inspect.signature(
+                self.module.loss_fn).parameters
+            if not self._pld_in_loss:
+                logger.warning(
+                    "progressive_layer_drop enabled but the model's loss_fn does "
+                    "not accept pld_theta — theta is scheduled but layers are NOT "
+                    "dropped (wrap blocks with "
+                    "runtime.progressive_layer_drop.layer_drop and add the kwarg)")
 
         # ---- step bookkeeping ----------------------------------------------------
         self.micro_steps = 0
@@ -351,15 +369,20 @@ class DeepSpeedEngine:
         )
 
     # --------------------------------------------------------------- internals
-    def _loss_and_scaled_grads(self, params, scale, batch, rng, step=None):
+    def _loss_and_scaled_grads(self, params, scale, batch, rng, step=None,
+                               pld_theta=None):
         """value_and_grad in compute dtype against fp32 masters; loss scaled pre-diff.
-        ``step`` (traced) gates the compression scheduler's QAT transforms."""
+        ``step`` (traced) gates the compression scheduler's QAT transforms;
+        ``pld_theta`` (traced) reaches opt-in models (see ``_pld_in_loss``)."""
 
         def f(p):
             p = tree_cast(p, self.compute_dtype)
             if self._compression is not None and step is not None:
                 p = self._compression.qat(p, step)
-            loss = self.module.loss_fn(p, batch, rng)
+            kwargs = {}
+            if self._pld_in_loss and pld_theta is not None:
+                kwargs["pld_theta"] = pld_theta
+            loss = self.module.loss_fn(p, batch, rng, **kwargs)
             if isinstance(loss, tuple):
                 loss = loss[0]
             return loss * scale.astype(loss.dtype), loss
@@ -431,7 +454,7 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         grad_shardings = self._grad_shardings
 
-        def accumulate(state: TrainState, batch):
+        def accumulate(state: TrainState, batch, pld_theta):
             step_rng = jax.random.fold_in(self._base_rng, state.global_step)
 
             def micro(acc, xs):
@@ -439,7 +462,7 @@ class DeepSpeedEngine:
                 rng = jax.random.fold_in(step_rng, idx)
                 loss, grads = self._loss_and_scaled_grads(
                     state.params, state.scaler.cur_scale, mb, rng,
-                    step=state.global_step)
+                    step=state.global_step, pld_theta=pld_theta)
                 acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                 acc = jax.lax.with_sharding_constraint(acc, grad_shardings)
                 return acc, loss
@@ -449,8 +472,8 @@ class DeepSpeedEngine:
             return jax.lax.scan(micro, acc0, (batch, jnp.arange(gas)))
 
         if self.offload_enabled:
-            def train_step_offload(state: TrainState, batch):
-                acc, losses = accumulate(state, batch)
+            def train_step_offload(state: TrainState, batch, pld_theta):
+                acc, losses = accumulate(state, batch, pld_theta)
                 new_state, grads_out, metrics = self._finalize_grads_offload(
                     state, acc, gas)
                 metrics["loss"] = jnp.mean(losses)
@@ -461,8 +484,8 @@ class DeepSpeedEngine:
                 out_shardings=(self._state_shardings, self._grad_shardings, None))
             return
 
-        def train_step(state: TrainState, batch, lr):
-            acc, losses = accumulate(state, batch)
+        def train_step(state: TrainState, batch, lr, pld_theta):
+            acc, losses = accumulate(state, batch, pld_theta)
             new_state, metrics = self._apply_update(state, acc, lr, gas)
             metrics["loss"] = jnp.mean(losses)
             return new_state, metrics
@@ -566,11 +589,13 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = np.float32(self.get_lr_value())
+        theta = np.float32(self.progressive_layer_drop.get_theta()
+                           if self.progressive_layer_drop is not None else 1.0)
         if self.offload_enabled:
-            self.state, grads, metrics = jitted(self.state, gbatch)
+            self.state, grads, metrics = jitted(self.state, gbatch, theta)
             self._host_optimizer_step(grads, lr, metrics)
         else:
-            self.state, metrics = jitted(self.state, gbatch, lr)
+            self.state, metrics = jitted(self.state, gbatch, lr, theta)
         self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=True)
 
@@ -584,6 +609,8 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         if self.curriculum_scheduler is not None:
             self.curriculum_scheduler.update_difficulty(self._host_steps)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self._host_steps)
         self._last_metrics = metrics
         self._write_monitor_events(metrics)
         if self._host_steps % self._config.steps_per_print == 0:
@@ -610,9 +637,10 @@ class DeepSpeedEngine:
 
         def step_fn(state, batch):
             jitted = self._fns["train_step"]
+            theta = np.float32(1.0)
             if self.offload_enabled:
-                return jitted(state, batch)
-            return jitted(state, batch, lr)
+                return jitted(state, batch, theta)
+            return jitted(state, batch, lr, theta)
 
         try:
             profiler.profile_step(lambda s, b: step_fn(s, b), self.state, gbatch,
@@ -778,9 +806,10 @@ class DeepSpeedEngine:
         self.checkpoint_engine.save(self.state._asdict(), os.path.join(path, "state"))
         if self.offload_enabled:
             # host-resident fp32 masters + moments (reference: offloaded optimizer
-            # partitions serialize through the same checkpoint, stage_1_and_2.py:2235)
-            self.checkpoint_engine.save(self._offload_tier.state_dict(),
-                                        os.path.join(path, "offload_state"))
+            # partitions serialize through the same checkpoint, stage_1_and_2.py:2235);
+            # the NVMe tier streams its moment files by copy, never through RAM
+            self._offload_tier.save_to(self.checkpoint_engine,
+                                       os.path.join(path, "offload_state"))
         side = {
             "global_step": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -825,9 +854,7 @@ class DeepSpeedEngine:
             off_path = os.path.join(path, "offload_state")
             if load_optimizer_states and not load_module_only \
                     and os.path.isdir(off_path):
-                restored_off = self.checkpoint_engine.load(
-                    off_path, template=self._offload_tier.state_dict())
-                self._offload_tier.load_state_dict(restored_off)
+                self._offload_tier.load_from(self.checkpoint_engine, off_path)
                 # device params re-derive from the restored masters (they are the source
                 # of truth in offload mode)
                 self.state = self.state._replace(

@@ -52,7 +52,8 @@ class PipelineEngine(DeepSpeedEngine):
     # The pipelined step consumes ALL microbatches in one loss evaluation (the fill/drain
     # loop), so the base engine's gas-scan is replaced by a single value_and_grad.
     def _build_train_step(self):
-        def train_step(state: TrainState, batch, lr):
+        def train_step(state: TrainState, batch, lr, pld_theta):
+            del pld_theta  # PLD is a per-block concern; pipeline modules opt in
             rng = jax.random.fold_in(self._base_rng, state.global_step)
             loss, grads = self._loss_and_scaled_grads(
                 state.params, state.scaler.cur_scale, batch, rng,

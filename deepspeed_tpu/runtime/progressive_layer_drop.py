@@ -1,0 +1,56 @@
+"""Progressive Layer Dropping (PLD).
+
+Behavioural equivalent of reference ``deepspeed/runtime/progressive_layer_drop.py``
+(``ProgressiveLayerDrop``): the global keep-probability schedule
+``theta(t) = (1 - theta) * exp(-gamma * t) + theta`` from Zhang & He 2020
+(arXiv:2010.13369), plus the depth-dependent per-layer keep probability and a jit-safe
+stochastic-depth wrapper (the reference threads ``pld_theta`` into its transformer
+kernel; here the model applies :func:`layer_drop` around each block).
+"""
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+
+def keep_prob(theta, layer_idx: int, num_layers: int):
+    """Depth-scaled keep probability: deeper layers drop more
+    (PLD paper eq. 6: ``p_l = 1 - l/L * (1 - theta)``)."""
+    frac = (layer_idx + 1) / num_layers
+    return 1.0 - frac * (1.0 - theta)
+
+
+def layer_drop(layer_fn: Callable, x, rng, theta, layer_idx: int,
+               num_layers: int):
+    """Stochastic-depth wrapper: with prob ``1 - p_l`` the block becomes identity
+    (residual passthrough); outputs are scaled by ``1/p_l`` when kept so the forward
+    is unbiased. Jit-safe: the draw is a where-select, no recompilation as theta
+    anneals (pass theta as a traced scalar)."""
+    p = jnp.asarray(keep_prob(theta, layer_idx, num_layers), jnp.float32)
+    keep = jax.random.bernoulli(rng, p)
+    y = layer_fn(x)
+    return jnp.where(keep, x + (y - x) / p, x)

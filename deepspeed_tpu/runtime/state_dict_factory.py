@@ -155,10 +155,11 @@ class SDLoaderFactory:
     """Reference ``SDLoaderFactory:20``: resolve a checkpoint descriptor to a loader."""
 
     @staticmethod
-    def get_sd_loader_json(json_or_dir: str):
+    def get_sd_loader_json(json_or_dir: str) -> "ShardedStateDict":
         if os.path.isdir(json_or_dir):
             return ShardedStateDict(json_or_dir)
-        with open(json_or_dir) as f:
-            data = json.load(f)
-        # Megatron-style descriptor: {"type": ..., "checkpoints": [files...]}
-        return data
+        raise NotImplementedError(
+            "Megatron-style descriptor jsons ({'type':..., 'checkpoints': [...]}) "
+            "are not supported yet — point at the checkpoint DIRECTORY (HF index "
+            "json / single-file layouts); use merge_mp_tensors/split_mp_tensor for "
+            "MP re-partitioning")

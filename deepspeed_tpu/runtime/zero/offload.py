@@ -55,38 +55,68 @@ class _NVMeMomentStore:
                        for i in range(len(masters))]
         max_size = max(self.sizes)
         self._scratch = [np.empty(2 * max_size, np.float32) for _ in range(2)]
-        # zero-init the on-disk moments THROUGH the scratch buffer: host RAM must
-        # never hold more than masters + 2 scratch (the point of this tier)
-        zeros = self._scratch[0]
-        zeros[:] = 0.0
-        for f, s in zip(self._files, self.sizes):
-            self.handle.sync_pwrite(zeros[:2 * s], f)
+        # lazy zero-init: a leaf whose file was never written reads as zeros from
+        # the scratch fill — avoids a full-disk zero pass at startup that a
+        # checkpoint resume would immediately overwrite anyway
+        self._dirty = [False] * len(self.sizes)
+
+    def _fetch(self, i: int, buf: np.ndarray):
+        """Start streaming leaf ``i``'s moments into ``buf`` (zeros if unwritten)."""
+        if self._dirty[i]:
+            self.handle.async_pread(buf[:2 * self.sizes[i]], self._files[i])
+        else:
+            buf[:2 * self.sizes[i]] = 0.0
 
     def adam_step_all(self, masters, grads, lr, step, betas, eps, weight_decay,
                       adam_w_mode, bias_correction):
         from ...ops.adam.cpu_adam import adam_step
         n = len(masters)
         buf = self._scratch
-        self.handle.async_pread(buf[0][:2 * self.sizes[0]], self._files[0])
+        self._fetch(0, buf[0])
         self.handle.wait()
         for i in range(n):
             if i + 1 < n:  # overlap: next leaf's moments stream in during compute
-                self.handle.async_pread(buf[(i + 1) % 2][:2 * self.sizes[i + 1]],
-                                        self._files[i + 1])
+                self._fetch(i + 1, buf[(i + 1) % 2])
             s = self.sizes[i]
             mv = buf[i % 2]
             adam_step(masters[i], mv[:s], mv[s:2 * s], grads[i], lr,
                       betas[0], betas[1], eps, weight_decay, adam_w_mode, step,
                       bias_correction)
             self.handle.async_pwrite(mv[:2 * s], self._files[i])
+            self._dirty[i] = True
             self.handle.wait()
+
+    # ------------------------------------------------------------------ streaming ckpt
+    def copy_files_to(self, dest_dir: str):
+        """Checkpoint the on-disk moments by FILE COPY — no host-RAM materialisation
+        (the moments are already serialized; reading them back only to re-serialize
+        would blow the tier's memory budget)."""
+        import os
+        import shutil
+        os.makedirs(dest_dir, exist_ok=True)
+        self.handle.wait()
+        for i, f in enumerate(self._files):
+            if self._dirty[i]:
+                shutil.copy2(f, os.path.join(dest_dir, os.path.basename(f)))
+
+    def copy_files_from(self, src_dir: str):
+        import os
+        import shutil
+        for i, f in enumerate(self._files):
+            src = os.path.join(src_dir, os.path.basename(f))
+            if os.path.isfile(src):
+                shutil.copy2(src, f)
+                self._dirty[i] = True
 
     # ------------------------------------------------------------------ checkpoint
     def read_moments(self):
+        """Materialise all moments in host RAM — tests/small models only; the
+        engine's checkpoint path streams via :meth:`copy_files_to` instead."""
         ms, vs = [], []
         for i, s in enumerate(self.sizes):
-            mv = np.empty(2 * s, np.float32)
-            self.handle.sync_pread(mv, self._files[i])
+            mv = np.zeros(2 * s, np.float32)
+            if self._dirty[i]:
+                self.handle.sync_pread(mv, self._files[i])
             ms.append(mv[:s].copy())
             vs.append(mv[s:].copy())
         return ms, vs
@@ -203,6 +233,35 @@ class OffloadOptimizerTier:
             np.copyto(dst, np.asarray(l, dtype=np.float32).reshape(-1))
 
     # ------------------------------------------------------------------ checkpoint
+    def save_to(self, checkpoint_engine, path: str):
+        """Engine checkpoint hook. NVMe mode streams moments by file copy (no RAM
+        materialisation); RAM mode serialises the full state dict."""
+        if self.nvme is not None:
+            import os
+            light = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])
+                                 for i, m in enumerate(self.masters)},
+                     "step": np.int64(self.step_count)}
+            checkpoint_engine.save(light, path)
+            self.nvme.copy_files_to(path + "_moments")
+            return
+        checkpoint_engine.save(self.state_dict(), path)
+
+    def load_from(self, checkpoint_engine, path: str):
+        import os
+        if self.nvme is not None:
+            light = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])
+                                 for i, m in enumerate(self.masters)},
+                     "step": np.int64(0)}
+            restored = checkpoint_engine.load(path, template=light)
+            for i, m in enumerate(self.masters):
+                np.copyto(m, np.asarray(restored["masters"][f"leaf{i}"],
+                                        dtype=np.float32).reshape(-1))
+            self.step_count = int(restored["step"])
+            self.nvme.copy_files_from(path + "_moments")
+            return
+        self.load_state_dict(checkpoint_engine.load(path,
+                                                    template=self.state_dict()))
+
     def state_dict(self) -> dict:
         shapes = {f"leaf{i}": np.asarray(s, dtype=np.int64)
                   for i, s in enumerate(self._shapes)}

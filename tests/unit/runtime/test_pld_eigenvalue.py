@@ -1,0 +1,124 @@
+"""Progressive layer drop + eigenvalue tests (reference
+``tests/unit/runtime/test_pld.py`` + MoQ eigenvalue territory)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (ProgressiveLayerDrop,
+                                                          keep_prob, layer_drop)
+
+from tests.unit.simple_model import base_config, random_batches, simple_model
+
+
+class TestPLD:
+    def test_theta_schedule(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        thetas = [pld.update_state(t) for t in range(0, 1000, 100)]
+        assert thetas[0] == pytest.approx(0.5 + 0.5, rel=1e-6)  # exp(0) term
+        assert all(b <= a for a, b in zip(thetas, thetas[1:]))  # monotone decay
+        assert thetas[-1] == pytest.approx(0.5, abs=1e-3)       # floor at theta
+
+    def test_keep_prob_depth_scaling(self):
+        ps = [keep_prob(0.5, i, 10) for i in range(10)]
+        assert all(b <= a for a, b in zip(ps, ps[1:]))  # deeper -> lower
+        assert ps[-1] == pytest.approx(0.5)
+
+    def test_layer_drop_unbiased(self):
+        """E[layer_drop(f, x)] ≈ f(x) over many rng draws (inverted scaling)."""
+        x = jnp.ones((4,))
+        f = lambda h: h * 3.0
+        outs = [layer_drop(f, x, jax.random.PRNGKey(i), theta=0.6,
+                           layer_idx=3, num_layers=4) for i in range(500)]
+        mean = np.mean([np.asarray(o) for o in outs], axis=0)
+        np.testing.assert_allclose(mean, 3.0, rtol=0.1)
+        # dropped draws are identity
+        dropped = [o for o in outs if np.allclose(np.asarray(o), 1.0)]
+        assert len(dropped) > 50  # p = 1 - 1*(1-0.6) = 0.6 keep -> ~40% dropped
+
+    def test_engine_wiring(self):
+        cfg = base_config(batch_size=16)
+        cfg["progressive_layer_drop"] = {"enabled": True, "theta": 0.6,
+                                         "gamma": 0.1}
+        eng, *_ = deepspeed_tpu.initialize(model=simple_model(16), config=cfg)
+        assert eng.progressive_layer_drop is not None
+        for b in random_batches(3, 16):
+            eng.train_batch(b)
+        state = eng.progressive_layer_drop.get_state()
+        assert state["progressive_layer_drop"] is True
+        assert 0.6 <= state["pld_theta"] < 1.0
+
+
+class TestEigenvalue:
+    def test_known_quadratic(self):
+        """loss = sum_b 0.5 x_b^T A_b x_b: per-block Hessian is A_b with known
+        dominant eigenvalues; post-processing normalises by the max."""
+        eigs_true = [4.0, 2.0, 8.0]
+        mats = [np.diag([e] + [0.5] * 3).astype(np.float32) for e in eigs_true]
+        A = jnp.asarray(np.stack(mats))           # (3, 4, 4) stacked blocks
+        params = {"h": {"x": jnp.asarray(
+            np.random.default_rng(0).standard_normal((3, 4)), jnp.float32)}}
+
+        def loss(p):
+            x = p["h"]["x"]
+            return 0.5 * jnp.sum(jnp.einsum("bi,bij,bj->b", x, A, x))
+
+        ev = Eigenvalue(max_iter=50, tol=1e-4, layer_name="h", layer_num=3)
+        vals = ev.compute_eigenvalue(loss, params)
+        np.testing.assert_allclose(vals, [0.5, 0.25, 1.0], rtol=1e-2)
+
+    def test_post_process(self):
+        assert Eigenvalue.post_process([2.0, -4.0, 0.0]) == [0.5, 1.0, 1.0]
+
+    def test_gpt2_blocks_run(self):
+        """Power iteration through a real model's stacked body converges to
+        positive normalised values."""
+        from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_model
+        cfg = GPT2Config(vocab_size=64, n_positions=16, n_embd=16, n_layer=2,
+                         n_head=2, dropout=0.0)
+        model = gpt2_model(cfg, sample_seq_len=16)
+        params = model.init_fn(jax.random.PRNGKey(0))
+        ids = np.random.default_rng(0).integers(0, 64, (2, 16)).astype(np.int32)
+
+        def loss(p):
+            out = model.loss_fn(p, {"input_ids": ids}, jax.random.PRNGKey(0))
+            return out[0] if isinstance(out, tuple) else out
+
+        ev = Eigenvalue(max_iter=8, tol=1e-2, layer_name="h", layer_num=2)
+        vals = ev.compute_eigenvalue(loss, params)
+        assert len(vals) == 2
+        assert all(0 < v <= 1.0 for v in vals)
+        assert max(vals) == 1.0
+
+
+class TestPLDThroughLoss:
+    def test_theta_reaches_optin_model(self):
+        """A model whose loss_fn accepts pld_theta receives the ANNEALED theta as a
+        traced value — losses track the schedule without recompilation."""
+        from deepspeed_tpu.models.base import Model
+
+        def init_fn(rng):
+            return {"w": jnp.ones((1,))}
+
+        def loss_fn(params, batch, rng, pld_theta=1.0):
+            # loss deliberately equals theta so the schedule is observable
+            return jnp.sum(params["w"]) * 0.0 + pld_theta
+
+        model = Model(loss_fn=loss_fn, init_fn=init_fn, name="pld_probe")
+        cfg = base_config(batch_size=16)
+        cfg["progressive_layer_drop"] = {"enabled": True, "theta": 0.5,
+                                         "gamma": 0.5}
+        eng, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        assert eng._pld_in_loss
+        losses = [float(eng.train_batch(b)) for b in random_batches(4, 16)]
+        pld = deepspeed_tpu.runtime.progressive_layer_drop.ProgressiveLayerDrop(
+            theta=0.5, gamma=0.5)
+        expected = [1.0]  # step 0 trains with the initial theta
+        for t in range(1, 4):
+            expected.append(pld.update_state(t))
+        np.testing.assert_allclose(losses, expected, rtol=1e-5)
