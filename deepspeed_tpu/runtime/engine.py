@@ -498,9 +498,10 @@ class DeepSpeedEngine:
         """Eager-compatible forward/backward/step path (reference API)."""
         grad_shardings = self._grad_shardings
 
-        def fwd_bwd(params, scale, batch, rng, step):
+        def fwd_bwd(params, scale, batch, rng, step, pld_theta):
             loss, grads = self._loss_and_scaled_grads(params, scale, batch, rng,
-                                                      step=step)
+                                                      step=step,
+                                                      pld_theta=pld_theta)
             # fp32 accumulation regardless of param dtype (the fused path's acc0 is fp32;
             # bf16/fp16 accumulation across microbatches would drop small contributions)
             grads = tree_cast(grads, jnp.float32)
@@ -671,9 +672,11 @@ class DeepSpeedEngine:
         gb = self._globalize(batch)
         rng = jax.random.fold_in(
             jax.random.fold_in(self._base_rng, self.state.global_step), self.micro_steps)
+        theta = np.float32(self.progressive_layer_drop.get_theta()
+                           if self.progressive_layer_drop is not None else 1.0)
         loss, grads = self._fns["fwd_bwd"](self.state.params,
                                            self.state.scaler.cur_scale,
-                                           gb, rng, self.state.global_step)
+                                           gb, rng, self.state.global_step, theta)
         self._cached_grads = grads
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
@@ -724,6 +727,10 @@ class DeepSpeedEngine:
         self._host_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self._host_steps)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self._host_steps)
         self._last_metrics = metrics
         self.timers(STEP_GLOBAL_TIMER).stop(sync=False)
         self._write_monitor_events(metrics)
@@ -869,6 +876,9 @@ class DeepSpeedEngine:
             # fast-forward difficulty to the resumed step (custom schedules aside,
             # difficulty is a pure function of the step)
             self.curriculum_scheduler.update_difficulty(self._host_steps)
+        if self.progressive_layer_drop is not None:
+            # theta is likewise a pure function of the step
+            self.progressive_layer_drop.update_state(self._host_steps)
         side = self.checkpoint_engine.load(os.path.join(path, "client_state.pkl"))
         self.micro_steps = side.get("micro_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None \

@@ -53,11 +53,10 @@ class PipelineEngine(DeepSpeedEngine):
     # loop), so the base engine's gas-scan is replaced by a single value_and_grad.
     def _build_train_step(self):
         def train_step(state: TrainState, batch, lr, pld_theta):
-            del pld_theta  # PLD is a per-block concern; pipeline modules opt in
             rng = jax.random.fold_in(self._base_rng, state.global_step)
             loss, grads = self._loss_and_scaled_grads(
                 state.params, state.scaler.cur_scale, batch, rng,
-                step=state.global_step)
+                step=state.global_step, pld_theta=pld_theta)
             grads = jax.lax.with_sharding_constraint(grads, self._grad_shardings)
             new_state, metrics = self._apply_update(state, grads, lr, 1)
             metrics["loss"] = loss

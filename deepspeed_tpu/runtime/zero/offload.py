@@ -107,6 +107,11 @@ class _NVMeMomentStore:
             if os.path.isfile(src):
                 shutil.copy2(src, f)
                 self._dirty[i] = True
+            else:
+                # leaf absent from the checkpoint = it was all-zeros when saved;
+                # clearing dirty makes the next fetch zero-fill instead of reading
+                # this run's stale on-disk moments
+                self._dirty[i] = False
 
     # ------------------------------------------------------------------ checkpoint
     def read_moments(self):
@@ -126,6 +131,7 @@ class _NVMeMomentStore:
             mv = np.concatenate([np.asarray(m, np.float32).reshape(-1),
                                  np.asarray(v, np.float32).reshape(-1)])
             self.handle.sync_pwrite(mv, self._files[i])
+            self._dirty[i] = True  # the next _fetch must READ, not zero-fill
 
 
 class OffloadOptimizerTier:
