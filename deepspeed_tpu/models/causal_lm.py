@@ -49,6 +49,7 @@ class CausalLMConfig:
     tie_word_embeddings: bool = True
     qkv_bias: bool = True
     mlp_bias: bool = True
+    lm_head_bias: bool = False               # GPT-J ties nothing and biases the head
     dtype: Any = jnp.bfloat16
     init_std: float = 0.02
     name: str = "causal-lm"
@@ -478,7 +479,8 @@ class CausalLM(nn.Module):
         if cfg.tie_word_embeddings:
             logits = x.astype(jnp.float32) @ wte.T
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                              dtype=jnp.float32,
                               kernel_init=nn.initializers.normal(cfg.init_std),
                               name="lm_head")(x.astype(jnp.float32))
         if caches is None:

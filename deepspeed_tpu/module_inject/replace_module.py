@@ -16,8 +16,8 @@ from typing import Any, Callable, Dict, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.causal_lm import (CausalLMConfig, bloom_cfg, gpt2_cfg, gptneox_cfg,
-                                llama_cfg, opt_cfg)
+from ..models.causal_lm import (CausalLMConfig, bloom_cfg, gpt2_cfg, gptj_cfg,
+                                gptneox_cfg, llama_cfg, opt_cfg)
 from ..utils.logging import logger
 
 
@@ -172,28 +172,41 @@ def _convert_opt(model) -> Tuple[CausalLMConfig, Any]:
     return cfg, params
 
 
-def _convert_llama(model) -> Tuple[CausalLMConfig, Any]:
+def _convert_llama(model, qkv_bias: bool = False,
+                   name: str = "llama") -> Tuple[CausalLMConfig, Any]:
+    """LLaMA-family layout walk, shared by llama/mistral/qwen2 (which differ only in
+    qkv biases, name, and window clamping)."""
     hf = model.config
     cfg = llama_cfg(vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
                     n_embd=hf.hidden_size, n_layer=hf.num_hidden_layers,
                     n_head=hf.num_attention_heads,
                     n_kv_head=getattr(hf, "num_key_value_heads", None),
                     d_ff=hf.intermediate_size, ln_eps=hf.rms_norm_eps,
-                    rotary_base=getattr(hf, "rope_theta", 10000.0))
+                    rotary_base=getattr(hf, "rope_theta", 10000.0),
+                    qkv_bias=qkv_bias, name=name)
     sd = model.state_dict()
     pfx = "model." if any(k.startswith("model.") for k in sd) else ""
     params = {"wte": jnp.asarray(_np(sd[f"{pfx}embed_tokens.weight"])),
               "ln_f": {"scale": _vec(sd[f"{pfx}norm.weight"])}}
     if "lm_head.weight" in sd:
         params["lm_head"] = {"kernel": _kernel(sd["lm_head.weight"])}
+    else:
+        cfg.tie_word_embeddings = True  # checkpoint ties the head to wte
+
+    def proj(path, with_bias):
+        out = {"kernel": _kernel(sd[f"{path}.weight"])}
+        if with_bias:
+            out["bias"] = _vec(sd[f"{path}.bias"])
+        return out
+
     for i in range(cfg.n_layer):
         lp = f"{pfx}layers.{i}"
         params[f"layers_{i}"] = {
             "ln_attn": {"scale": _vec(sd[f"{lp}.input_layernorm.weight"])},
             "ln_mlp": {"scale": _vec(sd[f"{lp}.post_attention_layernorm.weight"])},
-            "q_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.q_proj.weight"])},
-            "k_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.k_proj.weight"])},
-            "v_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.v_proj.weight"])},
+            "q_proj": proj(f"{lp}.self_attn.q_proj", qkv_bias),
+            "k_proj": proj(f"{lp}.self_attn.k_proj", qkv_bias),
+            "v_proj": proj(f"{lp}.self_attn.v_proj", qkv_bias),
             "o_proj": {"kernel": _kernel(sd[f"{lp}.self_attn.o_proj.weight"])},
             "gate_proj": {"kernel": _kernel(sd[f"{lp}.mlp.gate_proj.weight"])},
             "up_proj": {"kernel": _kernel(sd[f"{lp}.mlp.up_proj.weight"])},
@@ -234,12 +247,100 @@ def _convert_gptneox(model) -> Tuple[CausalLMConfig, Any]:
     return cfg, params
 
 
+def _rotary_interleaved_to_half(kernel, bias, n_head: int, head_dim: int,
+                                rotary_dim: int):
+    """Re-order q/k projection outputs from GPT-J's INTERLEAVED rotary pairing
+    ((2i, 2i+1) per frequency) to this model's NeoX half-split pairing
+    ((i, i + rot/2)). Permuting q and k identically leaves attention scores
+    invariant, and NeoX rotary on the permuted layout equals the permutation of
+    GPT-J rotary on the original — the standard GPT-J → NeoX weight conversion."""
+    perm_head = np.arange(head_dim)
+    half = rotary_dim // 2
+    perm_head[:half] = np.arange(0, rotary_dim, 2)
+    perm_head[half:rotary_dim] = np.arange(1, rotary_dim, 2)
+    perm = np.concatenate([h * head_dim + perm_head for h in range(n_head)])
+    out = {"kernel": kernel[:, perm]}
+    if bias is not None:
+        out["bias"] = bias[perm]
+    return out
+
+
+def _convert_gptj(model) -> Tuple[CausalLMConfig, Any]:
+    """GPT-J (reference container ``module_inject/containers/gptj.py``): parallel
+    residual with ONE shared layernorm, partial interleaved rotary, biasless
+    q/k/v/out, biased mlp + lm_head."""
+    hf = model.config
+    head_dim = hf.n_embd // hf.n_head
+    cfg = gptj_cfg(vocab_size=hf.vocab_size, max_seq_len=hf.n_positions,
+                   n_embd=hf.n_embd, n_layer=hf.n_layer, n_head=hf.n_head,
+                   d_ff=hf.n_inner or 4 * hf.n_embd,
+                   rotary_pct=hf.rotary_dim / head_dim,
+                   ln_eps=hf.layer_norm_epsilon,
+                   qkv_bias=False, tie_word_embeddings=False, lm_head_bias=True)
+    sd = model.state_dict()
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    params = {"wte": jnp.asarray(_np(sd[f"{pfx}wte.weight"])),
+              "ln_f": _ln(sd, f"{pfx}ln_f"),
+              "lm_head": {"kernel": _kernel(sd["lm_head.weight"]),
+                          "bias": _vec(sd["lm_head.bias"])}}
+    zero_o_bias = jnp.zeros((cfg.n_embd,), jnp.float32)
+    for i in range(cfg.n_layer):
+        lp = f"{pfx}h.{i}"
+        shared_ln = _ln(sd, f"{lp}.ln_1")
+        q = _rotary_interleaved_to_half(
+            _kernel(sd[f"{lp}.attn.q_proj.weight"]), None,
+            cfg.n_head, head_dim, hf.rotary_dim)
+        k = _rotary_interleaved_to_half(
+            _kernel(sd[f"{lp}.attn.k_proj.weight"]), None,
+            cfg.n_head, head_dim, hf.rotary_dim)
+        params[f"layers_{i}"] = {
+            # GPT-J shares one LN across the parallel branches; duplicating it into
+            # the two-LN parallel-residual block is numerically identical
+            "ln_attn": shared_ln, "ln_mlp": shared_ln,
+            "q_proj": q, "k_proj": k,
+            "v_proj": {"kernel": _kernel(sd[f"{lp}.attn.v_proj.weight"])},
+            # out_proj is biasless in GPT-J but the block's o_proj follows mlp_bias:
+            # a zero bias is exact
+            "o_proj": {"kernel": _kernel(sd[f"{lp}.attn.out_proj.weight"]),
+                       "bias": zero_o_bias},
+            "fc_in": {"kernel": _kernel(sd[f"{lp}.mlp.fc_in.weight"]),
+                      "bias": _vec(sd[f"{lp}.mlp.fc_in.bias"])},
+            "fc_out": {"kernel": _kernel(sd[f"{lp}.mlp.fc_out.weight"]),
+                       "bias": _vec(sd[f"{lp}.mlp.fc_out.bias"])},
+        }
+    return cfg, params
+
+
+def _convert_mistral(model) -> Tuple[CausalLMConfig, Any]:
+    """Mistral (reference container ``containers/llama.py`` family): identical param
+    layout to LLaMA; sliding-window attention is clamped by limiting max_seq_len to
+    the window (within it the semantics coincide)."""
+    cfg, params = _convert_llama(model)
+    window = getattr(model.config, "sliding_window", None)
+    if window:
+        if cfg.max_seq_len > window:
+            logger.warning(f"mistral: clamping max_seq_len {cfg.max_seq_len} -> "
+                           f"sliding_window {window} (windowed attention beyond it "
+                           "is not implemented)")
+        cfg.max_seq_len = min(cfg.max_seq_len, window)
+    cfg.name = "mistral"
+    return cfg, params
+
+
+def _convert_qwen2(model) -> Tuple[CausalLMConfig, Any]:
+    """Qwen2 (``containers/`` llama family): LLaMA layout + biases on q/k/v only."""
+    return _convert_llama(model, qkv_bias=True, name="qwen2")
+
+
 HF_POLICIES: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
     "bloom": _convert_bloom,
     "opt": _convert_opt,
     "llama": _convert_llama,
     "gpt_neox": _convert_gptneox,
+    "gptj": _convert_gptj,
+    "mistral": _convert_mistral,
+    "qwen2": _convert_qwen2,
 }
 
 

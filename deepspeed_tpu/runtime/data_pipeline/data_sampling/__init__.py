@@ -1,2 +1,3 @@
 """Data sampling (reference data_pipeline/data_sampling)."""
 from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder, make_dataset)
+from .data_sampler import DeepSpeedDataSampler

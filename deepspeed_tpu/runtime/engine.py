@@ -211,14 +211,20 @@ class DeepSpeedEngine:
             return fused_lamb(betas=oc["betas"], eps=oc["eps"],
                               weight_decay=oc["weight_decay"],
                               max_coeff=oc["max_coeff"], min_coeff=oc["min_coeff"])
-        if name in ("onebitadam", "zerooneadam"):
-            from .fp16.onebit.adam import onebit_adam, zero_one_adam
+        if name in ("onebitadam", "zerooneadam", "onebitlamb"):
+            from .fp16.onebit import onebit_adam, onebit_lamb, zero_one_adam
             extra = oc["extra"]
             if name == "onebitadam":
                 return onebit_adam(betas=oc["betas"], eps=oc["eps"],
                                    weight_decay=oc["weight_decay"],
                                    freeze_step=extra.get("freeze_step", 100),
                                    adam_w_mode=oc["adam_w_mode"])
+            if name == "onebitlamb":
+                return onebit_lamb(betas=oc["betas"], eps=oc["eps"],
+                                   weight_decay=oc["weight_decay"],
+                                   freeze_step=extra.get("freeze_step", 100),
+                                   max_coeff=oc["max_coeff"],
+                                   min_coeff=oc["min_coeff"])
             return zero_one_adam(
                 betas=oc["betas"], eps=oc["eps"],
                 weight_decay=oc["weight_decay"],

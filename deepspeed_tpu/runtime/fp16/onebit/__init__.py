@@ -1,2 +1,3 @@
 """1-bit optimizers (reference deepspeed/runtime/fp16/onebit)."""
 from .adam import onebit_adam, zero_one_adam
+from .lamb import onebit_lamb

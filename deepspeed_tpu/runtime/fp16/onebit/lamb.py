@@ -1,0 +1,85 @@
+"""1-bit LAMB.
+
+Behavioural equivalent of reference ``deepspeed/runtime/fp16/onebit/lamb.py``
+(``OnebitLamb``, Li et al. 2021): plain LAMB for ``freeze_step`` warmup steps; in the
+compression stage the variance AND the per-tensor LAMB scaling are FROZEN (the trust
+ratio recorded at the freeze boundary keeps steering step sizes) while the momentum is
+1-bit sign-compressed with error feedback — the property that makes layerwise adaptive
+rates survive compressed communication.
+
+Same single-controller mapping as :mod:`.adam`: compression applies to the global
+momentum with a persistent error residual; the wire-level collective for explicit
+shard_map pipelines is ``comm.compressed.compressed_allreduce``.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.optimizer import Optimizer
+from .adam import _sign_compress
+
+
+class OnebitLambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: any
+    exp_avg_sq: any
+    error: any
+    frozen_trust: any       # per-tensor trust ratio recorded at the freeze boundary
+
+
+def onebit_lamb(betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100,
+                max_coeff: float = 10.0, min_coeff: float = 0.01) -> Optimizer:
+    beta1, beta2 = betas
+
+    def init(params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return OnebitLambState(
+            step=jnp.int32(0),
+            exp_avg=jax.tree_util.tree_map(zeros, params),
+            exp_avg_sq=jax.tree_util.tree_map(zeros, params),
+            error=jax.tree_util.tree_map(zeros, params),
+            frozen_trust=jax.tree_util.tree_map(
+                lambda p: jnp.float32(1.0), params),
+        )
+
+    def update(grads, state: OnebitLambState, params, lr):
+        step = state.step + 1
+        frozen = step > freeze_step
+        at_boundary = step == freeze_step
+        bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+        bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+
+        def upd(p, g, m, v, e, tr):
+            g = g.astype(jnp.float32)
+            m_raw = beta1 * m + (1.0 - beta1) * g
+            m_comp, e_new = _sign_compress(m_raw, e)
+            m_new = jnp.where(frozen, m_comp, m_raw)
+            e_out = jnp.where(frozen, e_new, e)
+            v_new = jnp.where(frozen, v, beta2 * v + (1.0 - beta2) * g * g)
+            u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay != 0.0:
+                u = u + weight_decay * p.astype(jnp.float32)
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            live_trust = jnp.where(u_norm > 0, p_norm / u_norm, 1.0)
+            live_trust = jnp.where(p_norm > 0, live_trust, 1.0)
+            live_trust = jnp.clip(live_trust, min_coeff, max_coeff)
+            # record the ratio at the boundary; afterwards keep steering with it
+            # (the reference's frozen lamb_coeffs)
+            tr_new = jnp.where(at_boundary, live_trust, tr)
+            trust = jnp.where(frozen, tr_new, live_trust)
+            return (p - lr * trust * u).astype(p.dtype), m_new, v_new, e_out, tr_new
+
+        out = jax.tree_util.tree_map(upd, params, grads, state.exp_avg,
+                                     state.exp_avg_sq, state.error,
+                                     state.frozen_trust)
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), OnebitLambState(step=step, exp_avg=pick(1),
+                                        exp_avg_sq=pick(2), error=pick(3),
+                                        frozen_trust=pick(4))
+
+    return Optimizer(init=init, update=update, name="OnebitLamb")

@@ -355,3 +355,47 @@ class TestLambEndToEnd:
         losses = [float(eng.train_batch(b)) for b in random_batches(10, 16)]
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+
+class TestOnebitLamb:
+    def test_matches_lamb_in_warmup(self):
+        from deepspeed_tpu.ops.lamb.fused_lamb import fused_lamb
+        from deepspeed_tpu.runtime.fp16.onebit import onebit_lamb
+        import jax.numpy as jnp
+        params = {"w": jnp.asarray(
+            np.random.default_rng(0).standard_normal(64), jnp.float32)}
+        a, ob = fused_lamb(), onebit_lamb(freeze_step=100)
+        sa, sb = a.init(params), ob.init(params)
+        pa, pb = params, params
+        for i in range(5):
+            g = {"w": jnp.asarray(
+                np.random.default_rng(20 + i).standard_normal(64), jnp.float32)}
+            pa, sa = a.update(g, sa, pa, jnp.float32(1e-2))
+            pb, sb = ob.update(g, sb, pb, jnp.float32(1e-2))
+        np.testing.assert_allclose(np.asarray(pa["w"]), np.asarray(pb["w"]),
+                                   rtol=1e-6)
+
+    def test_frozen_stage_invariants(self):
+        from deepspeed_tpu.runtime.fp16.onebit import onebit_lamb
+        import jax.numpy as jnp
+        params = {"w": jnp.ones(16)}
+        ob = onebit_lamb(freeze_step=2)
+        s = ob.init(params)
+        p = params
+        for _ in range(2):
+            p, s = ob.update({"w": jnp.full(16, 0.3)}, s, p, jnp.float32(1e-2))
+        v0 = np.asarray(s.exp_avg_sq["w"]).copy()
+        trust0 = float(s.frozen_trust["w"])
+        for _ in range(3):
+            p, s = ob.update({"w": jnp.full(16, 3.0)}, s, p, jnp.float32(1e-2))
+        np.testing.assert_array_equal(np.asarray(s.exp_avg_sq["w"]), v0)
+        assert float(s.frozen_trust["w"]) == trust0
+        assert np.isfinite(np.asarray(p["w"])).all()
+
+    def test_engine_config(self):
+        cfg = base_config(batch_size=16)
+        cfg["optimizer"] = {"type": "OneBitLamb",
+                            "params": {"lr": 1e-2, "freeze_step": 2}}
+        eng, *_ = deepspeed_tpu.initialize(model=simple_model(16), config=cfg)
+        losses = [float(eng.train_batch(b)) for b in random_batches(4, 16)]
+        assert np.isfinite(losses).all()

@@ -252,3 +252,32 @@ def test_hf_generate_through_engine():
     with torch.no_grad():
         hf_out = hf.generate(torch.tensor(ids), max_new_tokens=5, do_sample=False)
     np.testing.assert_array_equal(out, hf_out.numpy())
+
+
+def test_hf_gptj_conversion():
+    hf = transformers.GPTJForCausalLM(transformers.GPTJConfig(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        rotary_dim=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0))
+    hf.eval()
+    ids = np.random.default_rng(7).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)
+
+
+def test_hf_mistral_conversion():
+    hf = transformers.MistralForCausalLM(transformers.MistralConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=64, max_position_embeddings=64,
+        sliding_window=64, attention_dropout=0.0))
+    hf.eval()
+    ids = np.random.default_rng(8).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)
+
+
+def test_hf_qwen2_conversion():
+    hf = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=64, max_position_embeddings=64,
+        attention_dropout=0.0, tie_word_embeddings=False))
+    hf.eval()
+    ids = np.random.default_rng(9).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)

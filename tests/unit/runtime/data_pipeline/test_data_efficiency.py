@@ -167,3 +167,99 @@ class TestIndexedDataset:
         (tmp_path / "bad.bin").write_bytes(b"")
         with pytest.raises(ValueError, match="magic"):
             MMapIndexedDataset(str(tmp_path / "bad"))
+
+
+class TestDataSampler:
+    def _cfg(self, difficulty_type="value", max_d=64):
+        return {
+            "seed": 7,
+            "data_sampling": {
+                "num_epochs": 4,
+                "curriculum_learning": {
+                    "enabled": True,
+                    "curriculum_metrics": {
+                        "seqlen": {
+                            "difficulty_type": difficulty_type,
+                            "clustering_type": "schedule_based",
+                            "min_difficulty": 8, "max_difficulty": max_d,
+                            "schedule_type": "fixed_linear",
+                            "schedule_config": {"total_curriculum_step": 10,
+                                                "difficulty_step": 8}}}}}}
+
+    def test_value_based_gating(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+            DeepSpeedDataSampler)
+        n = 256
+        seqlens = np.random.default_rng(0).integers(1, 65, n)
+        s = DeepSpeedDataSampler(self._cfg(), n, micro_batch_size=4,
+                                 data_parallel_rank=0, data_parallel_size=2,
+                                 gradient_accumulation_steps=2,
+                                 metric_values={"seqlen": seqlens})
+        it = iter(s)
+        early = [next(it) for _ in range(4)]
+        # early batches contain only easy samples (difficulty starts at 8)
+        for mb in early[:2]:
+            assert mb.shape == (4,)
+            assert (seqlens[mb] <= 16).all(), seqlens[mb]
+        # drain most of the schedule: difficulty reaches max, all samples eligible
+        for _ in range(40):
+            next(it)
+        late = next(it)
+        assert s.current_difficulties["seqlen"] == 64
+        assert (seqlens[late] <= 64).all()
+
+    def test_percentile_based_gating(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+            DeepSpeedDataSampler)
+        n = 200
+        scores = np.arange(n, dtype=np.float64)  # sample i has difficulty rank i
+        cfg = self._cfg(difficulty_type="percentile", max_d=100)
+        s = DeepSpeedDataSampler(cfg, n, micro_batch_size=8,
+                                 data_parallel_rank=0, data_parallel_size=1,
+                                 gradient_accumulation_steps=1,
+                                 metric_values={"seqlen": scores})
+        batch = s.get_next_global_batch()
+        # first difficulty ~8th percentile -> only the lowest-ranked samples
+        assert batch.max() < n * 0.2
+
+    def test_ranks_partition_disjointly(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+            DeepSpeedDataSampler)
+        n = 128
+        vals = np.full(n, 1)
+        cfg = self._cfg()
+
+        def rank_stream(rank):
+            s = DeepSpeedDataSampler(cfg, n, micro_batch_size=4,
+                                     data_parallel_rank=rank,
+                                     data_parallel_size=2,
+                                     gradient_accumulation_steps=1,
+                                     metric_values={"seqlen": vals})
+            it = iter(s)
+            return [next(it) for _ in range(3)]
+
+        a, b = rank_stream(0), rank_stream(1)
+        for mb_a, mb_b in zip(a, b):
+            assert set(mb_a.tolist()).isdisjoint(mb_b.tolist())
+
+    def test_state_roundtrip(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+            DeepSpeedDataSampler)
+        n = 64
+        vals = np.random.default_rng(1).integers(1, 65, n)
+        mk = lambda: DeepSpeedDataSampler(self._cfg(), n, micro_batch_size=4,
+                                          data_parallel_rank=0,
+                                          data_parallel_size=1,
+                                          gradient_accumulation_steps=1,
+                                          metric_values={"seqlen": vals})
+        a = mk()
+        it = iter(a)
+        for _ in range(5):
+            next(it)
+        state = a.state_dict()
+        next_a = next(it)
+
+        b = mk()
+        b.load_state_dict(state)
+        next_b = next(iter(b))
+        np.testing.assert_array_equal(next_a, next_b)
