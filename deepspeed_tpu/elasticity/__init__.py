@@ -1,7 +1,8 @@
 """Elastic training (reference ``deepspeed/elasticity``): batch-size/device-count
 co-design so jobs scale across a precomputed set of world sizes without convergence
-impact."""
+impact, plus the watchdog/restart agent."""
 from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
                      ElasticityIncompatibleWorldSize)
+from .elastic_agent import DSElasticAgent
 from .elasticity import (compute_elastic_config, elasticity_enabled,
                          ensure_immutable_elastic_config)

@@ -1,0 +1,124 @@
+"""Elastic training agent: failure detection + checkpoint-and-restart discipline.
+
+Behavioural equivalent of reference ``deepspeed/elasticity/elastic_agent.py``
+(``DSElasticAgent:25``, which extends torchelastic's ``LocalElasticAgent``): keep an
+elastic job healthy across worker failures and membership changes. TPU rethink of the
+same contract:
+
+- torchelastic restarts worker processes on rendezvous changes; on TPU slices the
+  cluster scheduler (GKE/Borg) replaces the WHOLE slice, so the agent's job is
+  (a) watchdog: detect a wedged/failed training loop (no step heartbeat within
+  ``heartbeat_timeout``) and force a distinct exit code the scheduler restarts on;
+  (b) on any exit path, best-effort checkpoint so the restart resumes;
+  (c) at (re)start, validate the new world size against ``compute_elastic_config``'s
+  valid set and return the batch/micro configuration for it (the reference computes
+  this inside ``_set_master_addr_port``-adjacent plumbing + config validation).
+
+Pure-host logic, testable without devices.
+"""
+
+import os
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import log_dist, logger
+from .config import ElasticityIncompatibleWorldSize
+from .elasticity import compute_elastic_config
+
+# Exit code the cluster scheduler treats as "restart me" (reference torchelastic
+# restarts on any nonzero; a distinct code separates wedge-kills from crashes).
+WATCHDOG_EXIT_CODE = 99
+
+
+class DSElasticAgent:
+    """Watchdog + resume coordinator around a training loop."""
+
+    def __init__(self, ds_config: Dict, world_size: Optional[int] = None,
+                 heartbeat_timeout: float = 1800.0,
+                 checkpoint_fn: Optional[Callable[[], None]] = None,
+                 on_wedge: Optional[Callable[[], None]] = None):
+        self.ds_config = ds_config
+        self.world_size = world_size or int(os.environ.get("WORLD_SIZE", "1"))
+        self.heartbeat_timeout = heartbeat_timeout
+        self.checkpoint_fn = checkpoint_fn
+        # default wedge action: checkpoint then hard-exit for the scheduler
+        self._on_wedge = on_wedge or self._default_wedge_action
+        self._last_beat = time.monotonic()
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.final_batch_size: Optional[int] = None
+        self.valid_world_sizes: List[int] = []
+        self.micro_batch: Optional[int] = None
+
+    # ------------------------------------------------------------------ membership
+    def validate_world_size(self) -> Dict:
+        """Check the current world size against the elastic config's valid set;
+        returns the resolved batch configuration (raises
+        ElasticityIncompatibleWorldSize like the reference runtime gate)."""
+        final, valid, micro = compute_elastic_config(
+            self.ds_config, world_size=self.world_size, return_microbatch=True)
+        self.final_batch_size, self.valid_world_sizes, self.micro_batch = \
+            final, valid, micro
+        log_dist(f"[elastic] world={self.world_size} valid={valid} "
+                 f"batch={final} micro={micro}", ranks=[0])
+        return {"train_batch_size": final,
+                "train_micro_batch_size_per_gpu": micro,
+                "valid_world_sizes": valid}
+
+    # ------------------------------------------------------------------ watchdog
+    def heartbeat(self):
+        """Call once per train step (cheap: one clock read)."""
+        self._last_beat = time.monotonic()
+
+    def _default_wedge_action(self):
+        logger.error(f"[elastic] no heartbeat for {self.heartbeat_timeout:.0f}s — "
+                     "checkpointing and exiting for scheduler restart")
+        if self.checkpoint_fn is not None:
+            try:
+                self.checkpoint_fn()
+            except Exception as e:  # the loop is wedged; save-or-die best effort
+                logger.error(f"[elastic] wedge checkpoint failed: {e}")
+        os._exit(WATCHDOG_EXIT_CODE)
+
+    def _watch(self):
+        while not self._stop.wait(min(self.heartbeat_timeout / 4, 60.0)):
+            if time.monotonic() - self._last_beat > self.heartbeat_timeout:
+                self._on_wedge()
+                return
+
+    def start(self):
+        self._last_beat = time.monotonic()
+        self._stop.clear()
+        self._watchdog = threading.Thread(target=self._watch, daemon=True,
+                                          name="ds-elastic-watchdog")
+        self._watchdog.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5.0)
+            self._watchdog = None
+
+    # ------------------------------------------------------------------ run wrapper
+    def run(self, train_loop: Callable[["DSElasticAgent"], None],
+            install_signal_handlers: bool = True):
+        """Run ``train_loop(agent)`` under the watchdog; SIGTERM (scheduler preemption)
+        triggers a best-effort checkpoint before exit (the reference launcher's
+        signal propagation + sigkill_handler discipline)."""
+        if install_signal_handlers:
+            def _term(signum, frame):
+                logger.warning(f"[elastic] signal {signum}: checkpointing before exit")
+                if self.checkpoint_fn is not None:
+                    try:
+                        self.checkpoint_fn()
+                    except Exception as e:
+                        logger.error(f"[elastic] preemption checkpoint failed: {e}")
+                raise SystemExit(128 + signum)
+            signal.signal(signal.SIGTERM, _term)
+        self.start()
+        try:
+            train_loop(self)
+        finally:
+            self.stop()

@@ -84,6 +84,7 @@ class DeepSpeedDataSampler:
                     self._metric_values[metric] = vals
                     self._metric_order[metric] = np.argsort(vals, kind="stable")
         self._pool: List[int] = []
+        self._warned_empty = False
 
     def __len__(self) -> int:
         return self.total_samples
@@ -113,15 +114,30 @@ class DeepSpeedDataSampler:
                 sel[self._metric_order[metric][:max(k, 1)]] = True
                 mask &= sel
         idx = np.nonzero(mask)[0]
-        return idx if idx.size else np.arange(self.one_epoch_total_samples)
+        if not idx.size:
+            if not self._warned_empty:
+                self._warned_empty = True
+                from ....utils.logging import logger
+                logger.warning(
+                    "curriculum: NO sample satisfies the current difficulty bounds "
+                    f"({self.current_difficulties}) — falling back to the full "
+                    "dataset; check min_difficulty against the metric range")
+            return np.arange(self.one_epoch_total_samples)
+        return idx
 
-    def _refill_pool(self):
+    def _refill_pool(self, exclude=()):
         eligible = self._eligible()
+        if exclude:
+            filtered = eligible[~np.isin(eligible, list(exclude))]
+            # only when the eligible set is smaller than one global batch do we
+            # allow repeats within a batch (unavoidable)
+            eligible = filtered if filtered.size else eligible
         self._pool = list(self.np_rng.permutation(eligible))
 
     def get_next_global_batch(self) -> np.ndarray:
         """Reference :299 — advance difficulties, then draw the next global batch
-        from the eligible pool (reshuffling on exhaustion)."""
+        from the eligible pool (reshuffling on exhaustion; a mid-batch reshuffle
+        excludes the batch's own samples so one batch never double-counts)."""
         if self.curriculum_enabled:
             self.curriculum_step += 1
             changed = False
@@ -135,7 +151,7 @@ class DeepSpeedDataSampler:
         batch = []
         while len(batch) < self.global_batch_size:
             if not self._pool:
-                self._refill_pool()
+                self._refill_pool(exclude=set(batch))
             batch.append(self._pool.pop())
         return np.asarray(batch, dtype=np.int64)
 
@@ -152,12 +168,17 @@ class DeepSpeedDataSampler:
                 return
             gb = self.get_next_global_batch()
             if remaining < self.global_batch_size:
-                gb = gb[:remaining]  # final partial batch (drop_last=False)
-            self.consumed_samples += len(gb)
+                # drop_last=False: pad the final batch by wrapping its own leading
+                # samples (Megatron-style) so every rank/microbatch keeps its full
+                # static shape; only the true remainder counts as consumed
+                pad = np.resize(gb[:remaining], self.global_batch_size)
+                gb = pad
+                self.consumed_samples += remaining
+            else:
+                self.consumed_samples += len(gb)
             per_round = self.data_parallel_size * self.micro_batch_size
             for i in range(0, len(gb), per_round):
-                micro = gb[i:i + per_round]
-                yield micro[start:min(end, len(micro))]
+                yield gb[i:i + per_round][start:end]
 
     # ------------------------------------------------------------------ state
     def state_dict(self) -> Dict:

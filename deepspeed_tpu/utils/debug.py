@@ -1,0 +1,64 @@
+"""Safe-mode / race-detection equivalents.
+
+The reference's closest tools (SURVEY §5.2): ZeRO-3 trace-validation (raises when the
+forward order diverges between iterations), ``safe_mode`` recomputation checks, and
+``CheckOverflow``. On TPU the compiled program cannot race internally — XLA emits one
+deterministic schedule — so the analogous hazards are HOST-side: accidental implicit
+device↔host transfers breaking the async pipeline, and nondeterminism sneaking in via
+unseeded host RNG or donated-buffer reuse. These helpers surface both:
+
+- :func:`set_transfer_guard` arms JAX's transfer guard so implicit transfers raise
+  (the transfer analogue of a race detector);
+- :func:`validate_determinism` runs a jitted step twice from identical inputs and
+  asserts bitwise-equal results — the ``safe_mode`` recomputation check.
+"""
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from .logging import logger
+
+
+def set_transfer_guard(level: str = "disallow"):
+    """Arm JAX's transfer guard: ``"allow" | "log" | "disallow"`` (reference safe-mode
+    spirit: make silent hazards loud). Affects implicit host↔device transfers only —
+    explicit ``device_put``/``device_get`` stay legal."""
+    jax.config.update("jax_transfer_guard", level)
+
+
+class DeterminismError(AssertionError):
+    pass
+
+
+def validate_determinism(step_fn: Callable, *args, n_runs: int = 2,
+                         rtol: float = 0.0, atol: float = 0.0) -> Any:
+    """Run ``step_fn(*args)`` ``n_runs`` times and assert identical outputs.
+
+    With default tolerances the check is BITWISE (XLA compiles one deterministic
+    schedule; divergence means host-side nondeterminism — unseeded rng, donated-buffer
+    aliasing, data races in input assembly). Returns the first run's output.
+
+    Note: donated-argument functions cannot be validated this way — pass a non-donating
+    wrapper or fresh pytrees per run.
+    """
+    outs = []
+    for i in range(n_runs):
+        out = step_fn(*args)
+        outs.append(jax.tree_util.tree_map(lambda l: np.asarray(l), out))
+    first = outs[0]
+    for i, other in enumerate(outs[1:], start=2):
+        leaves_a = jax.tree_util.tree_leaves(first)
+        leaves_b = jax.tree_util.tree_leaves(other)
+        for a, b in zip(leaves_a, leaves_b):
+            if rtol == 0.0 and atol == 0.0:
+                if not np.array_equal(a, b, equal_nan=True):
+                    raise DeterminismError(
+                        f"run 1 vs run {i}: outputs differ bitwise "
+                        f"(max abs diff {np.max(np.abs(a - b))}) — host-side "
+                        "nondeterminism (unseeded rng? donated buffer reuse?)")
+            else:
+                np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+    logger.info(f"determinism validated over {n_runs} runs")
+    return outs[0]

@@ -1,0 +1,50 @@
+"""Profiler range annotation.
+
+Behavioural equivalent of reference ``deepspeed/utils/nvtx.py`` (``instrument_w_nvtx``)
+and the accelerator ``range_push/range_pop`` surface: on TPU the profiler is XLA's —
+ranges become ``jax.profiler.TraceAnnotation`` named scopes, visible in TensorBoard's
+trace viewer / Perfetto exactly where NVTX ranges land in Nsight.
+"""
+
+import functools
+from typing import Callable
+
+import jax
+
+
+def instrument_w_nvtx(func: Callable) -> Callable:
+    """Decorate ``func`` so its execution appears as a named range in profiler traces
+    (name kept for reference source compatibility)."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+class _RangeStack:
+    def __init__(self):
+        self._stack = []
+
+    def push(self, name: str):
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        self._stack.append(ann)
+
+    def pop(self):
+        if self._stack:
+            self._stack.pop().__exit__(None, None, None)
+
+
+_ranges = _RangeStack()
+
+
+def range_push(name: str):
+    """Accelerator ``range_push`` (reference ``abstract_accelerator.py:161``)."""
+    _ranges.push(name)
+
+
+def range_pop():
+    _ranges.pop()
