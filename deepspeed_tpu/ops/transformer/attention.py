@@ -81,4 +81,7 @@ def get_attention_impl(name: str = "xla"):
     if name == "ring":
         from ..attention.ring import ring_attention
         return ring_attention
+    if name == "ulysses":
+        from ..attention.ulysses import ulysses_attention
+        return ulysses_attention
     raise ValueError(f"Unknown attention impl {name!r}")

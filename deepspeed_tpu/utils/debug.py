@@ -54,10 +54,14 @@ def validate_determinism(step_fn: Callable, *args, n_runs: int = 2,
         for a, b in zip(leaves_a, leaves_b):
             if rtol == 0.0 and atol == 0.0:
                 if not np.array_equal(a, b, equal_nan=True):
+                    try:  # bool/int leaves can't subtract; the diff is advisory only
+                        detail = f"(max abs diff {np.max(np.abs(a.astype(np.float64) - b.astype(np.float64)))})"
+                    except (TypeError, ValueError):
+                        detail = f"({np.sum(a != b)} differing elements)"
                     raise DeterminismError(
-                        f"run 1 vs run {i}: outputs differ bitwise "
-                        f"(max abs diff {np.max(np.abs(a - b))}) — host-side "
-                        "nondeterminism (unseeded rng? donated buffer reuse?)")
+                        f"run 1 vs run {i}: outputs differ bitwise {detail} — "
+                        "host-side nondeterminism (unseeded rng? donated buffer "
+                        "reuse?)")
             else:
                 np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
     logger.info(f"determinism validated over {n_runs} runs")

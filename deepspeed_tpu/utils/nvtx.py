@@ -7,6 +7,7 @@ trace viewer / Perfetto exactly where NVTX ranges land in Nsight.
 """
 
 import functools
+import threading
 from typing import Callable
 
 import jax
@@ -24,7 +25,10 @@ def instrument_w_nvtx(func: Callable) -> Callable:
     return wrapped
 
 
-class _RangeStack:
+class _RangeStack(threading.local):
+    """Thread-local: TraceAnnotation scopes are thread-bound, and the reference's
+    range_push/range_pop contract is per-thread."""
+
     def __init__(self):
         self._stack = []
 

@@ -20,16 +20,12 @@ import numpy as np
 from .logging import logger
 
 
-def _flatten_params(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+def _flatten_params(tree: Any) -> Dict[str, np.ndarray]:
+    import jax
     out: Dict[str, np.ndarray] = {}
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.update(_flatten_params(v, f"{prefix}{k}."))
-    elif isinstance(tree, (list, tuple)):
-        for i, v in enumerate(tree):
-            out.update(_flatten_params(v, f"{prefix}{i}."))
-    else:
-        out[prefix[:-1]] = np.asarray(tree, dtype=np.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out[name] = np.asarray(leaf, dtype=np.float32)
     return out
 
 
