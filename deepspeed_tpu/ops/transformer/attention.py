@@ -60,7 +60,8 @@ def _auto_attention(q, k, v, **kw):
 
 
 def get_attention_impl(name: str = "xla"):
-    """Resolve an attention implementation by name: ``auto`` | ``xla`` | ``flash`` | ``ring``.
+    """Resolve an attention implementation by name:
+    ``auto`` | ``xla`` | ``flash`` | ``ring`` | ``ulysses`` (or a pre-bound callable).
 
     ``auto`` on a real TPU backend dispatches by sequence length — XLA attention below
     ``FLASH_MIN_SEQ``, the Pallas flash kernel at/above it; elsewhere always XLA (on CPU

@@ -102,6 +102,19 @@ class DeepSpeedEngine:
                 "offload_optimizer currently supports single-host topologies "
                 "(all grads addressable from the controller); multi-host pods "
                 "would need per-process partition updates")
+        # no phantom config keys: features we don't implement fail loudly
+        op_cfg = self._config.zero_config.offload_param
+        if op_cfg is not None and op_cfg.device not in (None, "none"):
+            raise NotImplementedError(
+                "zero_optimization.offload_param (parameter offload to "
+                f"{op_cfg.device!r}) is not implemented — stage-3 fsdp sharding + "
+                "offload_optimizer cover the optimizer/master tier; parameter "
+                "streaming from host awaits mature jax memory-kind support")
+        if self._config.sparse_gradients_enabled:
+            logger.warning(
+                "sparse_gradients is a no-op on TPU: XLA gradients (including "
+                "embedding grads) are dense by construction; the flag is accepted "
+                "for config compatibility only")
 
         # ---- optimizer (reference _configure_optimizer:1261) --------------------
         self.optimizer = self._configure_optimizer(optimizer)

@@ -42,6 +42,12 @@ class PipelineEngine(DeepSpeedEngine):
         assert cfg.mesh.pipe == model.num_stages, \
             (f"config mesh.pipe={cfg.mesh.pipe} != PipelineModule.num_stages="
              f"{model.num_stages}")
+        # NOTE: in-stage tensor parallelism of the body is NOT auto-enabled: XLA
+        # aborts compiling auto-tensor-sharded params inside the partial-manual 1F1B
+        # shard_map (manual axis = pipe). A tensor axis in the mesh is still usable —
+        # params replicate over it and other model parts may shard — but body-TP
+        # under the SPMD pipe needs a manual-collective stage_fn (future work; see
+        # PipelineModule.param_specs(tp_axis=...) for the spec-side support).
         model_obj = model.to_model(mesh_spec=None, name=f"pipe{model.num_stages}")
         super().__init__(args=args, model=model_obj, optimizer=optimizer,
                          model_parameters=model_parameters, training_data=training_data,

@@ -340,16 +340,33 @@ class PipelineModule:
             lambda *ls: jnp.stack(ls), *body_stack)
         return params
 
-    def param_specs(self, abstract_params=None) -> Any:
-        """PartitionSpec tree: body stacked dim shards over ``pipe``; rest replicated (TP specs
-        can be layered on by the caller)."""
+    def param_specs(self, abstract_params=None, tp_axis: Optional[str] = None,
+                    tp_size: Optional[int] = None) -> Any:
+        """PartitionSpec tree: body stacked dim shards over ``pipe``; rest replicated.
+
+        ``tp_axis`` additionally shards each body weight's LAST dim over that mesh
+        axis when divisible — NAIVE last-dim weight sharding, not megatron row/col
+        classification (which needs per-weight roles; see ``gpt2_param_specs`` for
+        the path-aware version): GSPMD stays correct but may insert extra reshards.
+        ``tp_size`` defaults to the global mesh's axis size; it must match the mesh
+        the params will live on for the divisibility guard to mean anything.
+        Consumed by non-SPMD executors — the 1F1B shard_map path cannot carry
+        auto-tensor-sharded params (see ``runtime/pipe/engine.py``)."""
         if abstract_params is None:
             abstract_params = jax.eval_shape(self.init_fn, jax.random.PRNGKey(0))
+        if tp_axis and tp_size is None:
+            from ...parallel.mesh import get_global_mesh
+            mesh = get_global_mesh()
+            tp_size = mesh.size(tp_axis) if mesh is not None else 1
 
         def seg_spec(seg_name):
             def one(leaf):
                 if seg_name == "body":
-                    return P(AXIS_PIPE, *([None] * (leaf.ndim - 1)))
+                    entries = [AXIS_PIPE] + [None] * (leaf.ndim - 1)
+                    if (tp_axis and leaf.ndim >= 3 and tp_size and tp_size > 1 and
+                            leaf.shape[-1] % tp_size == 0):
+                        entries[-1] = tp_axis
+                    return P(*entries)
                 return P(*([None] * leaf.ndim))
             return one
 
@@ -712,7 +729,8 @@ class PipelineModule:
 
     # ------------------------------------------------------------------ model adapter
     def to_model(self, mesh_spec: Optional[MeshSpec] = None, name: str = "pipeline",
-                 remat: Optional[bool] = None, schedule: str = "1f1b"):
+                 remat: Optional[bool] = None, schedule: str = "1f1b",
+                 tp_axis: Optional[str] = None, tp_size: int = 1):
         """Bundle into the engine's :class:`Model` contract. ``loss_fn`` consumes microbatched
         batches ``(inputs, labels)`` with leading dim M and returns mean loss; ``rng=None``
         runs a deterministic (dropout-off) pass.
@@ -778,7 +796,8 @@ class PipelineModule:
             return self.reference_apply(params, inputs, rng)
 
         return Model(loss_fn=loss_fn, init_fn=self.init_fn, apply_fn=apply_fn,
-                     param_specs=self.param_specs(), name=name)
+                     param_specs=self.param_specs(tp_axis=tp_axis, tp_size=tp_size),
+                     name=name)
 
     def __len__(self):
         return len(self._layers)
