@@ -57,6 +57,7 @@ class MeshSpec:
 
     def __init__(self, axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
         devices = list(devices if devices is not None else jax.devices())
+        devices = order_devices_for_dcn(devices)
         n = len(devices)
         sizes = {ax: int(axis_sizes.get(ax, 1)) for ax in MESH_AXES}
         inferred = [ax for ax in MESH_AXES if sizes[ax] in (-1, 0)]
@@ -147,6 +148,29 @@ class MeshSpec:
 
     def get_sequence_parallel_world_size(self) -> int:
         return self.axis_sizes[AXIS_SEQ]
+
+
+def order_devices_for_dcn(devices: Sequence) -> List:
+    """Order devices so slice boundaries align with OUTER mesh axes.
+
+    Multi-slice TPU topologies connect chips within a slice by ICI and slices by
+    DCN (data-center network, ~100x lower bandwidth). ``MESH_AXES`` places ``pipe``
+    then ``data`` outermost precisely so that, when the device list enumerates one
+    whole slice before the next, the axes that cross slice boundaries are the
+    bandwidth-tolerant ones (pipeline p2p, data-parallel gradient reduction) while
+    tensor/seq/expert collectives stay on ICI — the standard multi-slice recipe
+    (cf. ``jax.experimental.mesh_utils.create_hybrid_device_mesh``).
+
+    Sorts by (slice_index, device id); devices without ``slice_index`` (single
+    slice, CPU backends) keep their original order.
+    """
+    try:
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    except Exception:
+        return list(devices)
+    if None in slice_ids or len(slice_ids) <= 1:
+        return list(devices)
+    return sorted(devices, key=lambda d: (d.slice_index, d.id))
 
 
 _GLOBAL_MESH: Optional[MeshSpec] = None

@@ -36,6 +36,10 @@ POLICIES = {
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
     "checkpoint_dots": "dots_saveable",
     "everything_saveable": "everything_saveable",
+    # activation CPU offload (reference checkpoint_in_cpu / cpu_checkpointing):
+    # matmul outputs are SAVED but live in pinned host memory, streamed back for
+    # the backward — HBM cost of full remat, compute cost of dots-saveable
+    "offload_dots": ("offload_dot_with_no_batch_dims", "device", "pinned_host"),
 }
 
 
@@ -46,6 +50,9 @@ def _resolve_policy(name: str):
     attr = POLICIES[name]
     if attr is None:
         return None
+    if isinstance(attr, tuple):
+        factory, *args = attr
+        return getattr(jax.checkpoint_policies, factory)(*args)
     return getattr(jax.checkpoint_policies, attr)
 
 
@@ -73,26 +80,38 @@ def is_configured() -> bool:
     return _config is not None
 
 
+def _active_policy_name(policy: Optional[str]) -> str:
+    if policy is not None:
+        return policy
+    if _config is not None:
+        # checkpoint_in_cpu / cpu_checkpointing promotes the policy to host offload
+        if getattr(_config, "cpu_checkpointing", False):
+            return "offload_dots"
+        return _config.policy
+    return "nothing_saveable"
+
+
 def checkpoint(function: Callable, *args, policy: Optional[str] = None) -> Any:
     """Recompute ``function``'s activations in the backward pass
     (reference ``checkpoint():749``). Usable before ``configure()`` — defaults to full
     recompute, like the reference's default config."""
-    name = policy or (_config.policy if _config is not None else "nothing_saveable")
-    pol = _resolve_policy(name)
+    pol = _resolve_policy(_active_policy_name(policy))
     wrapped = jax.checkpoint(function, policy=pol, prevent_cse=False)
     return wrapped(*args)
 
 
 def checkpoint_wrapper(function: Callable, policy: Optional[str] = None) -> Callable:
     """Decorator form: returns a rematerialising version of ``function``."""
-    name = policy or (_config.policy if _config is not None else "nothing_saveable")
-    pol = _resolve_policy(name)
+    pol = _resolve_policy(_active_policy_name(policy))
     return jax.checkpoint(function, policy=pol, prevent_cse=False)
 
 
 def reset():
-    """Reference ``reset()``: clear buffered state between iterations (no-op: nothing is
-    buffered host-side on TPU)."""
+    """Reference ``reset()``: clear buffered state between iterations. Also clears the
+    module-global config so ``checkpoint()`` returns to the unconfigured default —
+    nothing else is buffered host-side on TPU."""
+    global _config
+    _config = None
 
 
 def model_parallel_cuda_manual_seed(seed: int):

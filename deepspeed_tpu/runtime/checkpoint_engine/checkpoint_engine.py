@@ -63,7 +63,14 @@ class OrbaxCheckpointEngine(CheckpointEngine):
                 pickle.dump(state_dict, f)
             return
         self._ckptr.save(os.path.abspath(path), state_dict, force=True)
-        self._ckptr.wait_until_finished()
+        if not self.use_async:
+            self._ckptr.wait_until_finished()
+        # async_save: orbax's background thread drains the disk write while the
+        # caller proceeds to the side-state writes/barrier; engine.save_checkpoint's
+        # closing commit() is the durability barrier, so the overlap is WITHIN
+        # save_checkpoint (engine semantics require a durable checkpoint before
+        # 'latest' advances — full resume-while-draining would defer commit to the
+        # next save)
 
     def load(self, path: str, map_location=None, template: Any = None,
              shardings: Any = None) -> Any:

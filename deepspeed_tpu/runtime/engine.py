@@ -847,10 +847,12 @@ class DeepSpeedEngine:
         }
         self.checkpoint_engine.save(side, os.path.join(path, "client_state.pkl"))
         dist.barrier("ckpt_save")
+        # commit (the async-save drain barrier) BEFORE advancing 'latest': a crash
+        # mid-drain must leave 'latest' pointing at the previous durable checkpoint
+        self.checkpoint_engine.commit(tag)
         if save_latest and dist.get_rank() == 0:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(str(tag))
-        self.checkpoint_engine.commit(tag)
         return path
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,

@@ -730,7 +730,7 @@ class PipelineModule:
     # ------------------------------------------------------------------ model adapter
     def to_model(self, mesh_spec: Optional[MeshSpec] = None, name: str = "pipeline",
                  remat: Optional[bool] = None, schedule: str = "1f1b",
-                 tp_axis: Optional[str] = None, tp_size: int = 1):
+                 tp_axis: Optional[str] = None, tp_size: Optional[int] = None):
         """Bundle into the engine's :class:`Model` contract. ``loss_fn`` consumes microbatched
         batches ``(inputs, labels)`` with leading dim M and returns mean loss; ``rng=None``
         runs a deterministic (dropout-off) pass.

@@ -92,3 +92,21 @@ def test_tagged_checkpoints(tmp_path):
     e2 = _make_engine()
     e2.load_checkpoint(str(tmp_path / "ck"), tag="alpha")
     assert e2.global_steps == 1
+
+
+def test_async_save_roundtrip(tmp_path, eight_devices):
+    """checkpoint.async_save: save returns before the write drains; commit is the
+    completion barrier; the checkpoint restores identically."""
+    import jax
+    cfg = base_config(batch_size=16, stage=1)
+    cfg["checkpoint"] = {"async_save": True}
+    eng, *_ = ds.initialize(model=simple_model(16), config=cfg)
+    for b in random_batches(2, 16):
+        eng.train_batch(b)
+    eng.save_checkpoint(str(tmp_path))
+    eng2, *_ = ds.initialize(model=simple_model(16), config=cfg)
+    eng2.load_checkpoint(str(tmp_path))
+    a = jax.tree_util.tree_leaves(eng.state.params)
+    b = jax.tree_util.tree_leaves(eng2.state.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -147,3 +147,30 @@ class TestTraceAnnotation:
         range_pop()
         range_pop()
         range_pop()  # extra pop is harmless
+
+
+class TestActivationOffload:
+    def test_cpu_checkpointing_policy(self):
+        """checkpoint_in_cpu saves matmul outputs in pinned host memory (grads intact
+        vs plain remat) — the activation-offload tier (reference checkpointing.py:486)."""
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ac
+        ac.reset()
+        ac.configure(deepspeed_config=None, checkpoint_in_cpu=True)
+        try:
+            w = jnp.asarray(np.random.default_rng(0).standard_normal((64, 64)),
+                            jnp.float32)
+            x = jnp.ones((4, 64), jnp.float32)
+
+            def f(w_):
+                h = ac.checkpoint(lambda a, b: jnp.tanh(b @ a) @ a, w_, x)
+                return jnp.sum(h)
+
+            g_off = jax.jit(jax.grad(f))(w)
+            ac.reset()
+            g_plain = jax.jit(jax.grad(
+                lambda w_: jnp.sum(jax.checkpoint(
+                    lambda a, b: jnp.tanh(b @ a) @ a)(w_, x))))(w)
+            np.testing.assert_allclose(np.asarray(g_off), np.asarray(g_plain),
+                                       rtol=1e-6)
+        finally:
+            ac.reset()

@@ -57,3 +57,35 @@ def test_reference_api_shims(eight_devices):
     assert spec.get_model_parallel_world_size() == 2
     assert spec.get_pipe_parallel_world_size() == 2
     assert spec.get_sequence_parallel_world_size() == 1
+
+
+def test_order_devices_for_dcn():
+    """Multi-slice devices sort by (slice, id) so slice boundaries align with the
+    outer (DCN-tolerant) mesh axes; single-slice/CPU device lists pass through."""
+    from deepspeed_tpu.parallel.mesh import order_devices_for_dcn
+
+    class FakeDev:
+        def __init__(self, id, slice_index=None):
+            self.id = id
+            self.slice_index = slice_index
+
+        def __repr__(self):
+            return f"d{self.id}@s{self.slice_index}"
+
+    # interleaved enumeration across 2 slices -> grouped by slice
+    devs = [FakeDev(0, 1), FakeDev(1, 0), FakeDev(2, 1), FakeDev(3, 0)]
+    ordered = order_devices_for_dcn(devs)
+    assert [(d.slice_index, d.id) for d in ordered] == \
+        [(0, 1), (0, 3), (1, 0), (1, 2)]
+
+    # single slice: untouched order
+    devs1 = [FakeDev(2, 0), FakeDev(0, 0), FakeDev(1, 0)]
+    assert order_devices_for_dcn(devs1) == devs1
+
+    # CPU devices without slice_index: untouched
+    class NoSlice:
+        def __init__(self, id):
+            self.id = id
+
+    devs2 = [NoSlice(1), NoSlice(0)]
+    assert order_devices_for_dcn(devs2) == devs2
