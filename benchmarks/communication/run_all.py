@@ -1,0 +1,112 @@
+"""Collective micro-benchmarks — the ``ds_bench`` equivalent.
+
+Behavioural equivalent of reference ``benchmarks/communication/run_all.py`` (+
+``all_reduce.py``/``all_gather.py``/``all_to_all.py``/``pt2pt.py`` and ``bin/ds_bench``):
+sweep message sizes per collective and report latency + algorithmic/bus bandwidth with
+the same busbw factors (``utils/comms_logging.py``).
+
+TPU-native realisation: collectives are in-graph ``jax.lax`` ops over a named mesh axis,
+compiled by XLA onto ICI — each timing jits ONE collective over a shard_map and times
+repeated dispatches. Run on any topology:
+
+    python benchmarks/communication/run_all.py --maxsize 26 --trials 20
+    (CPU dev loop: XLA_FLAGS=--xla_force_host_platform_device_count=8
+     JAX_PLATFORMS=cpu python benchmarks/communication/run_all.py)
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser(description="deepspeed_tpu collective benchmarks")
+    p.add_argument("--trials", type=int, default=20)
+    p.add_argument("--warmups", type=int, default=3)
+    p.add_argument("--minsize", type=int, default=18, help="log2 min bytes")
+    p.add_argument("--maxsize", type=int, default=26, help="log2 max bytes")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--collectives", nargs="+",
+                   default=["all_reduce", "all_gather", "all_to_all",
+                            "reduce_scatter", "pt2pt"])
+    p.add_argument("--axis", default="data", help="mesh axis to benchmark over")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = get_args(argv)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.utils.comms_logging import calc_bw_log
+
+    n = jax.device_count()
+    if n < 2:
+        print(f"only {n} device(s): collective benchmarks need >= 2 "
+              "(use the virtual CPU mesh for a functional sweep)")
+        return 0
+    mesh = Mesh(np.asarray(jax.devices()), (args.axis,))
+    dtype = jnp.dtype(args.dtype)
+    ax = args.axis
+
+    def build(coll, n_elems):
+        """Jitted fn: (n_devices, n_elems) input sharded over axis → collective."""
+        def body(x):
+            x = x[0]
+            if coll == "all_reduce":
+                return jax.lax.psum(x, ax)[None]
+            if coll == "all_gather":
+                # keep the FULL gathered tensor live — slicing it would let XLA
+                # shrink the collective
+                return jax.lax.all_gather(x, ax).reshape(1, -1)
+            if coll == "reduce_scatter":
+                return jax.lax.psum_scatter(x, ax, tiled=True)[None]
+            if coll == "all_to_all":
+                return jax.lax.all_to_all(x.reshape(n, -1), ax, 0, 0,
+                                          tiled=False).reshape(1, -1)
+            if coll == "pt2pt":
+                return jax.lax.ppermute(x, ax,
+                                        [(i, (i + 1) % n) for i in range(n)])[None]
+            raise ValueError(coll)
+
+        mapped = jax.shard_map(body, mesh=mesh, axis_names={ax},
+                               in_specs=P(ax), out_specs=P(ax), check_vma=False)
+        return jax.jit(mapped)
+
+    header = f"{'collective':<15}{'bytes/rank':>14}{'lat(us)':>12}" \
+             f"{'algbw(GB/s)':>14}{'busbw(GB/s)':>14}"
+    print(f"devices={n} axis={ax} dtype={args.dtype} trials={args.trials}")
+    print(header)
+    print("-" * len(header))
+    for coll in args.collectives:
+        for log2 in range(args.minsize, args.maxsize + 1, 2):
+            nbytes = 2 ** log2
+            n_elems = max(128, nbytes // dtype.itemsize)
+            if coll == "all_to_all":
+                n_elems = (n_elems // n) * n or n
+            x = jnp.ones((n, n_elems), dtype)
+            fn = build(coll, n_elems)
+            out = fn(x)
+            jax.block_until_ready(out)
+            times = []
+            for _ in range(args.warmups):
+                jax.block_until_ready(fn(x))
+            for _ in range(args.trials):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x))
+                times.append(time.perf_counter() - t0)
+            lat = sorted(times)[len(times) // 2]
+            per_rank_bytes = n_elems * dtype.itemsize
+            # busbw factors match the reference's calc (comms_logging.calc_bw_log,
+            # which reports Gbit/s; /8 for GB/s)
+            _, algbw_gbps, busbw_gbps = calc_bw_log(coll, per_rank_bytes, lat, n)
+            print(f"{coll:<15}{per_rank_bytes:>14,}{lat * 1e6:>12.1f}"
+                  f"{algbw_gbps / 8:>14.2f}{busbw_gbps / 8:>14.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -637,6 +637,14 @@ class DeepSpeedEngine:
             log_dist(f"step={self._host_steps} loss={float(metrics['loss']):.4f} "
                      f"lr={float(lr):.3e} loss_scale={float(metrics['loss_scale']):.0f}",
                      ranks=[0])
+            if self._config.wall_clock_breakdown:
+                # reference engine.py wall_clock_breakdown: per-phase timer means each
+                # print interval (the fused path has one phase; the eager path adds
+                # fwd/bwd/step)
+                names = [n for n in (TRAIN_BATCH_TIMER, FORWARD_GLOBAL_TIMER,
+                                     BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER)
+                         if self.timers.has_timer(n)]
+                self.timers.log(names)
         return metrics["loss"]
 
     def _host_optimizer_step(self, grads, lr, metrics):
