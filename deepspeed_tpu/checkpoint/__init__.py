@@ -1,0 +1,14 @@
+"""Reference-format checkpoint tools (import/inspect Megatron-DeepSpeed runs).
+
+Counterpart of ``deepspeed/checkpoint/``: :class:`DeepSpeedCheckpoint` inspects a
+3D (pp × tp × dp) training checkpoint folder, merges tensor-parallel shards, rebuilds
+fp32 weights from ZeRO optimizer shards, and converts Megatron-GPT trees into this
+framework's :mod:`~deepspeed_tpu.models.causal_lm` parameters. THIS framework's own
+checkpoints need none of this — orbax arrays re-shard to any mesh on restore.
+"""
+
+from .constants import *  # noqa: F401,F403
+from .deepspeed_checkpoint import (DeepSpeedCheckpoint, merge_tp_shards,  # noqa: F401
+                                   split_megatron_qkv, to_causal_lm_params)
+from .reshape import (Model3DDescriptor, get_model_3d_descriptor,  # noqa: F401
+                      get_zero_files, reshape_3d, reshape_meg_2d_parallel)

@@ -12,7 +12,38 @@ those, with optional metadata the engine exploits:
 """
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Segment:
+    """One host-streamable slice of a model (ZeRO-3 parameter offload unit).
+
+    The reference fetches parameters per-submodule under autograd hooks
+    (``zero/partitioned_param_coordinator.py:239``); the JAX equivalent is an explicit
+    sequential decomposition: the engine streams one segment's parameters HBM-ward at a
+    time, runs its forward (and later its VJP with segment-granular rematerialisation),
+    and lets the previous segment's buffers die. ``kind`` fixes the apply signature:
+
+    - ``first``: ``apply_fn(params, batch, rng) -> carry``
+    - ``mid``:   ``apply_fn(params, carry, batch, rng) -> carry``
+    - ``last``:  ``apply_fn(params, carry, batch, rng) -> scalar loss``
+
+    ``params`` arrives as a TUPLE of subtrees ordered like ``param_keys`` (not a dict
+    keyed by name): equally-shaped segments then present identical pytree structures to
+    ``jax.jit``, so e.g. every interior layer group of a uniform transformer shares ONE
+    compiled forward and ONE compiled VJP regardless of depth.
+
+    ``param_keys`` are the top-level parameter-tree keys the segment consumes;
+    ``init_keys`` the (sub)set it materialises in ``init_fn`` — keys shared with an
+    earlier segment (tied embeddings) appear in ``param_keys`` only.
+    """
+    name: str
+    kind: str                      # "first" | "mid" | "last"
+    param_keys: Tuple[str, ...]
+    init_keys: Tuple[str, ...]
+    init_fn: Callable              # (rng) -> {key: subtree} for init_keys
+    apply_fn: Callable
 
 
 @dataclasses.dataclass
@@ -23,6 +54,8 @@ class Model:
     param_specs: Any = None
     flops_per_sample: Optional[float] = None
     name: str = "model"
+    # ZeRO-3 param-offload decomposition (None: model does not support offload_param)
+    segments: Optional[List[Segment]] = None
 
     def init(self, rng):
         return self.init_fn(rng)

@@ -488,9 +488,152 @@ class CausalLM(nn.Module):
         return logits, new_caches
 
 
+# ----------------------------------------------------------- segmented (offload_param)
+def _norm_mod(cfg: CausalLMConfig):
+    """Top-level (unnamed) norm module for standalone segment apply."""
+    if cfg.layernorm == "rmsnorm":
+        return nn.RMSNorm(epsilon=cfg.ln_eps, dtype=jnp.float32)
+    return nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32)
+
+
+def causal_lm_segments(cfg: CausalLMConfig, layers_per_group: int = 2):
+    """Decompose :class:`CausalLM` into host-streamable :class:`~.base.Segment` slices.
+
+    The segment parameter trees use the SAME top-level keys as the monolithic
+    ``CausalLM.init`` tree (``wte``/``wpe``/``ln_embed``/``layers_i``/``ln_f``/``lm_head``)
+    so checkpoints interchange between the streamed and the resident engines. Tied
+    embeddings put ``wte`` in the last segment's ``param_keys`` (shared, not re-initialised);
+    its gradient accumulates contributions from both ends, exactly like the monolithic
+    backward.
+
+    Reference: sub_group partitioning of ZeRO-3 params
+    (``runtime/zero/stage3.py`` ``sub_group_size``,
+    ``partitioned_param_coordinator.py:239`` fetch order).
+    """
+    from .base import Segment
+    from .gpt2 import cross_entropy_loss
+    segs = []
+
+    def _positions(ids):
+        b, t = ids.shape
+        return jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+
+    # ---- embed -----------------------------------------------------------------
+    embed_keys = ["wte"]
+    if cfg.pos_emb == "learned":
+        embed_keys.append("wpe")
+    if cfg.embed_layernorm:
+        embed_keys.append("ln_embed")
+
+    def embed_init(rng):
+        init = nn.initializers.normal(cfg.init_std)
+        p = {"wte": init(jax.random.fold_in(rng, 0),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)}
+        if cfg.pos_emb == "learned":
+            p["wpe"] = init(jax.random.fold_in(rng, 1),
+                            (cfg.max_seq_len, cfg.n_embd), jnp.float32)
+        if cfg.embed_layernorm:
+            p["ln_embed"] = _norm_mod(cfg).init(
+                jax.random.fold_in(rng, 2),
+                jnp.zeros((1, 1, cfg.n_embd), jnp.float32))["params"]
+        return tuple(p[k] for k in embed_keys)
+
+    def embed_apply(p, batch, rng):
+        p = dict(zip(embed_keys, p))
+        ids = batch["input_ids"]
+        x = p["wte"][ids].astype(cfg.dtype)
+        if cfg.pos_emb == "learned":
+            x = x + jnp.take(p["wpe"], _positions(ids), axis=0).astype(cfg.dtype)
+        if cfg.embed_layernorm:
+            x = _norm_mod(cfg).apply({"params": p["ln_embed"]}, x).astype(cfg.dtype)
+        return x
+
+    segs.append(Segment(name="embed", kind="first",
+                        param_keys=tuple(embed_keys), init_keys=tuple(embed_keys),
+                        init_fn=embed_init, apply_fn=embed_apply))
+
+    # ---- layer groups ----------------------------------------------------------
+    # One shared apply/init FUNCTION OBJECT per (is_moe flags) signature: segments with
+    # the same layer composition then present jax.jit with the same callable AND the
+    # same arg structure, so a 48-layer model compiles its interior group once, not 24×.
+    _group_fns = {}
+
+    def _fns_for(flags):
+        if flags not in _group_fns:
+            def group_init(rng, flags=flags):
+                x = jnp.zeros((1, 4, cfg.n_embd), cfg.dtype)
+                pos = jnp.zeros((1, 4), jnp.int32)
+                return tuple(
+                    CausalLMLayer(cfg, is_moe=moe).init(
+                        {"params": jax.random.fold_in(rng, j)}, x, pos)["params"]
+                    for j, moe in enumerate(flags))
+
+            def group_apply(p, x, batch, rng, flags=flags):
+                pos = _positions(batch["input_ids"])
+                for moe, layer_params in zip(flags, p):
+                    layer = CausalLMLayer(cfg, is_moe=moe)
+                    x, _ = layer.apply({"params": layer_params}, x, pos)
+                return x
+
+            _group_fns[flags] = (group_init, group_apply)
+        return _group_fns[flags]
+
+    for lo in range(0, cfg.n_layer, layers_per_group):
+        hi = min(lo + layers_per_group, cfg.n_layer)
+        keys = tuple(f"layers_{i}" for i in range(lo, hi))
+        flags = tuple(cfg.is_moe_layer(i) for i in range(lo, hi))
+        group_init, group_apply = _fns_for(flags)
+        segs.append(Segment(name=f"layers[{lo}:{hi}]", kind="mid", param_keys=keys,
+                            init_keys=keys, init_fn=group_init,
+                            apply_fn=group_apply))
+
+    # ---- final norm + head + loss ----------------------------------------------
+    final_init_keys = ["ln_f"] if cfg.tie_word_embeddings else ["ln_f", "lm_head"]
+    final_param_keys = ["ln_f", "wte"] if cfg.tie_word_embeddings \
+        else ["ln_f", "lm_head"]
+
+    def final_init(rng):
+        p = {"ln_f": _norm_mod(cfg).init(
+            jax.random.fold_in(rng, 0),
+            jnp.zeros((1, 1, cfg.n_embd), jnp.float32))["params"]}
+        if not cfg.tie_word_embeddings:
+            head = {"kernel": nn.initializers.normal(cfg.init_std)(
+                jax.random.fold_in(rng, 1),
+                (cfg.n_embd, cfg.vocab_size), jnp.float32)}
+            if cfg.lm_head_bias:
+                head["bias"] = jnp.zeros((cfg.vocab_size,), jnp.float32)
+            p["lm_head"] = head
+        return tuple(p[k] for k in final_init_keys)
+
+    def final_apply(p, x, batch, rng):
+        p = dict(zip(final_param_keys, p))
+        x = _norm_mod(cfg).apply({"params": p["ln_f"]}, x)
+        if cfg.tie_word_embeddings:
+            logits = x.astype(jnp.float32) @ p["wte"].T
+        else:
+            logits = x.astype(jnp.float32) @ p["lm_head"]["kernel"]
+            if cfg.lm_head_bias:
+                logits = logits + p["lm_head"]["bias"]
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, dtype=ids.dtype)], axis=1)
+        return cross_entropy_loss(logits, labels)
+
+    segs.append(Segment(name="final", kind="last",
+                        param_keys=tuple(final_param_keys),
+                        init_keys=tuple(final_init_keys),
+                        init_fn=final_init, apply_fn=final_apply))
+    return segs
+
+
 # ----------------------------------------------------------------------- bundles
-def causal_lm_model(cfg: CausalLMConfig, sample_seq_len: Optional[int] = None) -> Model:
-    """Training/scoring bundle (loss over shifted labels)."""
+def causal_lm_model(cfg: CausalLMConfig, sample_seq_len: Optional[int] = None,
+                    layers_per_group: int = 2) -> Model:
+    """Training/scoring bundle (loss over shifted labels). ``layers_per_group`` sets the
+    granularity of the offload_param streaming decomposition (see
+    :func:`causal_lm_segments`)."""
     from .gpt2 import cross_entropy_loss
     module = CausalLM(cfg)
     t = sample_seq_len or min(cfg.max_seq_len, 1024)
@@ -514,7 +657,8 @@ def causal_lm_model(cfg: CausalLMConfig, sample_seq_len: Optional[int] = None) -
 
     return Model(loss_fn=loss_fn, init_fn=init_fn, apply_fn=apply_fn,
                  param_specs=None, name=cfg.name,
-                 flops_per_sample=6.0 * cfg.num_params() * t)
+                 flops_per_sample=6.0 * cfg.num_params() * t,
+                 segments=causal_lm_segments(cfg, layers_per_group))
 
 
 def init_cache(cfg: CausalLMConfig, batch_size: int, max_len: Optional[int] = None,

