@@ -6,11 +6,21 @@ batch gemms in ``csrc/transformer/ds_transformer_cuda.cpp``): one kernel compute
 attention block with online softmax, never materialising the (t × t) score matrix in HBM —
 the memory behaviour the reference approximates with kernel fusion, taken to its fixed point.
 
-Algorithm: standard flash attention v2 tiling. Forward keeps running (max, sum, acc) per
-q-row-block while streaming k/v blocks through VMEM; saves per-row logsumexp for the backward.
-Backward recomputes probabilities blockwise from the saved logsumexp (dq kernel gridded over
-q blocks, dk/dv kernel gridded over k blocks) — no stored attention matrix, matching the
-activation-memory profile that makes long sequences feasible.
+Algorithm: flash attention v2 tiling with the K/V loop folded into the GRID's innermost
+dimension: the Pallas TPU pipeline then streams K/V blocks HBM→VMEM with automatic
+double-buffering (copy of block ``k+1`` overlaps compute on block ``k``), and the online
+softmax carry (m, l, acc) lives in VMEM scratch across grid steps. VMEM holds only
+one q block + two k/v blocks + carry — independent of sequence length, so there is NO
+sequence-length guard: 128k tokens stream exactly like 1k.
+
+Causality skips work at BLOCK granularity by index-map clamping: kv blocks entirely above
+the diagonal map to the previous block index, which the pipeline recognises (no HBM
+re-copy) while ``pl.when`` skips their compute — ~2× effective speedup for causal without
+a second grid.
+
+Backward recomputes probabilities blockwise from the saved logsumexp (dq kernel gridded
+over q blocks × kv blocks, dk/dv kernel over kv blocks × q blocks) — no stored attention
+matrix, matching the activation-memory profile that makes long sequences feasible.
 
 On CPU (tests) kernels run in interpreter mode automatically.
 """
@@ -42,107 +52,156 @@ def _block_sizes(t: int, block_q: int, block_k: int):
     return max(bq, 1), max(bk, 1)
 
 
+def _causal_k_hi(q_idx, bq, bk):
+    """Last kv-block index (inclusive) intersecting the causal triangle of q block."""
+    return ((q_idx + 1) * bq - 1) // bk
+
+
+def _causal_q_lo(k_idx, bq, bk):
+    """First q-block index intersecting the causal triangle of kv block."""
+    return (k_idx * bk) // bq
+
+
+def _k_index_map(causal, bq, bk):
+    """kv-block index map: under causality, blocks above the diagonal clamp to the
+    last needed block — same index as the previous grid step, so the pipeline skips
+    the copy while ``pl.when`` skips the compute. Shared by fwd and bwd-dq so the
+    two cannot drift."""
+    def k_index(i, j, kb):
+        if causal:
+            return (i, jnp.minimum(kb, _causal_k_hi(j, bq, bk)), 0)
+        return (i, kb, 0)
+    return k_index
+
+
+def _q_index_map(causal, bq, bk, extra_dims=0):
+    """q/lse-block index map for the dkv kernel: q blocks strictly above the causal
+    diagonal clamp forward to the first contributing block (no copy, no compute)."""
+    tail = (0,) * (1 + extra_dims)
+
+    def q_index(i, kb, qb):
+        if causal:
+            return (i, jnp.maximum(qb, _causal_q_lo(kb, bq, bk))) + tail
+        return (i, qb) + tail
+    return q_index
+
+
 # ----------------------------------------------------------------------- forward kernel
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, t_valid):
-    q = q_ref[0].astype(jnp.float32)          # (bq, d)
-    bq, d = q.shape
-    t = k_ref.shape[1]
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, nk, bq, bk, t_valid):
     j = pl.program_id(1)
-    q_start = j * bq
-    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    kb = pl.program_id(2)
 
-    nk = t // block_k
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    active = kb * bk < t_valid
     if causal:
-        # process only blocks intersecting the causal triangle
-        k_hi = jax.lax.div(q_start + bq + block_k - 1, block_k)
-        k_hi = jnp.minimum(k_hi, nk)
-    else:
-        k_hi = nk
+        active = jnp.logical_and(active, kb <= _causal_k_hi(j, bq, bk))
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                       # (bq, d)
+        k_blk = k_ref[0].astype(jnp.float32)                   # (bk, d)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        rows = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = cols < t_valid
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
         s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
+        m_prev = m_scr[0]                                      # (8, bq) broadcast rows
+        m_row = m_prev[0]                                      # (bq,)
+        m_new = jnp.maximum(m_row, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_row - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        l_new = l_scr[0][0] * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = acc_scr[...] * alpha[None, :, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[None]
+        m_scr[...] = jnp.broadcast_to(m_new[None, None, :], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[None, None, :], l_scr.shape)
 
-    m0 = jnp.full((bq,), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((bq,), dtype=jnp.float32)
-    acc0 = jnp.zeros((bq, d), dtype=jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, k_hi, body, (m0, l0, acc0))
-
-    l_safe = jnp.where(l > 0, l, 1.0)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse stored (bh, nq, 8, bq): TPU block tiling needs the last two dims (sublane, lane)
-    # aligned to (8, 128); the 8 duplicate sublanes cost t*32B and keep the layout legal
-    lse = (m + jnp.log(l_safe)).astype(jnp.float32)
-    lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        l = l_scr[0][0]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_scr[0] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse stored (bh, nq, 8, bq): TPU block tiling needs the last two dims
+        # (sublane, lane) aligned; the 8 duplicate sublanes keep the layout legal
+        lse = (m_scr[0][0] + jnp.log(l_safe)).astype(jnp.float32)
+        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
 def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid):
     """q3/k3/v3: (bh, t, d) padded to block multiples. Returns (o3, lse (bh, t))."""
     bh, t, d = q3.shape
     bq, bk = _block_sizes(t, block_q, block_k)
-    grid = (bh, t // bq)
+    nq, nk = t // bq, t // bk
+    grid = (bh, nq, nk)
+
+    k_index = _k_index_map(causal, bq, bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=bk, t_valid=t_valid)
+                               nk=nk, bq=bq, bk=bk, t_valid=t_valid)
     o3, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), k_index),
+            pl.BlockSpec((1, bk, d), k_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, 8, bq), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, t // bq, 8, bq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((1, 8, bq), jnp.float32),      # m (rows dup'd over sublanes)
+            pltpu.VMEM((1, 8, bq), jnp.float32),      # l
+            pltpu.VMEM((1, bq, d), jnp.float32),      # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(q3, k3, v3)
     return o3, lse[:, :, 0, :].reshape(bh, t)
 
 
 # ---------------------------------------------------------------------- backward kernels
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_k, t_valid):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0, 0]
-    delta = delta_ref[0, 0, 0]
-    bq, d = q.shape
-    t = k_ref.shape[1]
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+                   *, scale, causal, nk, bq, bk, t_valid):
     j = pl.program_id(1)
-    q_start = j * bq
-    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-    nk = t // block_k
-    if causal:
-        k_hi = jnp.minimum(jax.lax.div(q_start + bq + block_k - 1, block_k), nk)
-    else:
-        k_hi = nk
+    kb = pl.program_id(2)
 
-    def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    active = kb * bk < t_valid
+    if causal:
+        active = jnp.logical_and(active, kb <= _causal_k_hi(j, bq, bk))
+
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0]
+        delta = delta_ref[0, 0, 0]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        rows = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = cols < t_valid
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -151,100 +210,117 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[None]
 
-    dq = jax.lax.fori_loop(0, k_hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[0].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    *, scale, causal, block_q, t_valid):
-    k_blk = k_ref[0].astype(jnp.float32)      # (bk, d)
-    v_blk = v_ref[0].astype(jnp.float32)
-    bk, d = k_blk.shape
-    t = q_ref.shape[1]
+                    dk_scr, dv_scr, *, scale, causal, nq, bq, bk, t_valid):
     kb = pl.program_id(1)
-    k_start = kb * bk
-    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-    nq = t // block_q
-    q_lo = jax.lax.div(k_start, block_q) if causal else 0
+    qb = pl.program_id(2)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, qb, 0]                           # (block_q,)
-        delta_blk = delta_ref[0, qb, 0]
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    active = kb * bk < t_valid
+    if causal:
+        active = jnp.logical_and(active, qb >= _causal_q_lo(kb, bq, bk))
+
+    @pl.when(active)
+    def _compute():
+        k_blk = k_ref[0].astype(jnp.float32)      # (bk, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)      # (bq, d)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, 0]                # (bq,)
+        delta_blk = delta_ref[0, 0, 0]
         s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        rows = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        rows = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = cols < t_valid
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_blk[:, None])
-        dv = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[None]
         dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk[:, None]) * scale
-        dk = dk + jax.lax.dot_general(ds, q_blk, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[None]
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(q_lo, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qb == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[0].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[0].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, t_valid):
     bh, t, d = q3.shape
     bq, bk = _block_sizes(t, block_q, block_k)
-    nq = t // bq
+    nq, nk = t // bq, t // bk
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # (bh, t)
     lse_b = jnp.broadcast_to(lse.reshape(bh, nq, 1, bq), (bh, nq, 8, bq))
     delta_b = jnp.broadcast_to(delta.reshape(bh, nq, 1, bq), (bh, nq, 8, bq))
 
+    k_index = _k_index_map(causal, bq, bk)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, block_k=bk,
-                          t_valid=t_valid),
-        grid=(bh, nq),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, nk=nk,
+                          bq=bq, bk=bk, t_valid=t_valid),
+        grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, 8, bq), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, 8, bq), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), k_index),
+            pl.BlockSpec((1, bk, d), k_index),
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((1, bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse_b, delta_b)
 
+    q_index = _q_index_map(causal, bq, bk)
+    lse_index = _q_index_map(causal, bq, bk, extra_dims=1)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, block_q=bq,
-                          t_valid=t_valid),
-        grid=(bh, t // bk),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq,
+                          bq=bq, bk=bk, t_valid=t_valid),
+        grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, nq, 8, bq), lambda i, j: (i, 0, 0, 0)),
-            pl.BlockSpec((1, nq, 8, bq), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((1, bq, d), q_index),
+            pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
+            pl.BlockSpec((1, bq, d), q_index),
+            pl.BlockSpec((1, 1, 8, bq), lse_index),
+            pl.BlockSpec((1, 1, 8, bq), lse_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((1, bk, d), jnp.float32),
+                        pltpu.VMEM((1, bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse_b, delta_b)
     return dq, dk, dv
@@ -279,11 +355,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, mask: Optional[jnp.ndarray] = None,
                     softmax_scale: Optional[float] = None,
                     dropout_rate: float = 0.0, dropout_rng=None,
-                    block_q: int = 128, block_k: int = 128) -> jnp.ndarray:
+                    block_q: int = 512, block_k: int = 512) -> jnp.ndarray:
     """Drop-in replacement for ``xla_attention``: q/k/v ``(b, t, h, d)`` → ``(b, t, h, d)``.
 
     Falls back to the XLA path for features the kernel does not cover (arbitrary masks,
-    attention dropout, cross-attention with different kv length).
+    attention dropout, cross-attention with different kv length). There is no
+    sequence-length guard: K/V blocks stream through the grid pipeline, so VMEM use is
+    O(block) regardless of t.
     """
     from ..transformer.attention import xla_attention
     if mask is not None or dropout_rate > 0.0 or q.shape[1] != k.shape[1]:
@@ -291,14 +369,6 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                              softmax_scale=softmax_scale,
                              dropout_rate=dropout_rate, dropout_rng=dropout_rng)
     b, t, h, d = q.shape
-    # VMEM guard: the fwd/dq kernels stage full-length K+V per batch-head (the dkv kernel
-    # full Q+dO); with Pallas double-buffering that is ~4·t·d·itemsize bytes, which must fit
-    # the ~16 MiB VMEM alongside block buffers. Beyond the budget, route to the XLA path —
-    # very long sequences belong to ring_attention (seq-axis sharding) anyway. TODO: stream
-    # K/V blocks from HBM via pltpu.make_async_copy (decode.py pattern) to lift this.
-    vmem_budget = 8 * 1024 * 1024
-    if 4 * t * d * q.dtype.itemsize > vmem_budget:
-        return xla_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
 
     def local(q4, k4, v4):

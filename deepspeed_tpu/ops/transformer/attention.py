@@ -45,15 +45,19 @@ def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-# Below this sequence length XLA's fused attention beats the Pallas flash kernel on
-# TPU (measured on v5e, GPT-2 125M bf16: equal at 2048, flash 1.65x at 4096, xla
-# 1.07x at 1024 — XLA's one fused kernel amortises better when the score matrix is
-# small; flash's tiling wins once t^2 dominates).
-FLASH_MIN_SEQ = 2048
+# Minimum sequence length for the Pallas flash kernel under ``auto``. Since the
+# grid-pipelined rewrite (K/V streamed through the grid's innermost dim, online-softmax
+# carry in VMEM scratch) flash wins at EVERY measured length — v5e, GPT-2-shaped
+# b*t=8192 h=12 d=64 bf16, fwd: 2.6x at 1024 / 8.6x at 4096; fwd+bwd: 2.8x at 1024 /
+# 6.3x at 4096 (see tests/unit/ops/test_flash_crossover.py) — so the kernel floor only
+# excludes degenerate tiny shapes where block padding dominates.
+FLASH_MIN_SEQ = 256
 
 
 def _auto_attention(q, k, v, **kw):
-    if q.shape[1] >= FLASH_MIN_SEQ:
+    # t % 128: non-aligned lengths degrade _block_sizes to tiny MXU-starved blocks —
+    # those shapes stay on XLA (the measured wins are on 128-multiple lengths)
+    if q.shape[1] >= FLASH_MIN_SEQ and q.shape[1] % 128 == 0:
         from ..attention.flash import flash_attention
         return flash_attention(q, k, v, **kw)
     return xla_attention(q, k, v, **kw)
@@ -63,10 +67,10 @@ def get_attention_impl(name: str = "xla"):
     """Resolve an attention implementation by name:
     ``auto`` | ``xla`` | ``flash`` | ``ring`` | ``ulysses`` (or a pre-bound callable).
 
-    ``auto`` on a real TPU backend dispatches by sequence length — XLA attention below
-    ``FLASH_MIN_SEQ``, the Pallas flash kernel at/above it; elsewhere always XLA (on CPU
-    the Pallas kernel runs in interpreter mode, which is orders of magnitude slower —
-    fine for kernel unit tests, wrong as a default).
+    ``auto`` on a real TPU backend dispatches by sequence length — the Pallas flash
+    kernel from ``FLASH_MIN_SEQ`` up (it beats XLA at all measured lengths), XLA below;
+    elsewhere always XLA (on CPU the Pallas kernel runs in interpreter mode, which is
+    orders of magnitude slower — fine for kernel unit tests, wrong as a default).
     """
     if callable(name):
         return name  # pre-bound impl (e.g. make_sparse_attention_impl(config))
