@@ -62,10 +62,7 @@ class InferenceEngine:
         if isinstance(model, CausalLMConfig):
             cfg = model
             if params is None:
-                module = CausalLM(cfg)
-                params = module.init(
-                    {"params": jax.random.PRNGKey(seed)},
-                    jnp.zeros((1, 8), jnp.int32))["params"]
+                params = self._init_params_segmented(cfg, seed)
             return cfg, params
         if isinstance(model, tuple) and len(model) == 2:
             cfg, params = model
@@ -80,6 +77,31 @@ class InferenceEngine:
         # HF torch module → policy conversion (module_inject analogue)
         from ..module_inject.replace_module import convert_hf_model
         return convert_hf_model(model)
+
+    def _init_params_segmented(self, cfg, seed):
+        """Random weights in the SERVE dtype, initialised one model segment at a time
+        (reuses the offload_param decomposition): a 7B bf16 model inits in ~14 GB of
+        HBM instead of the ~28 GB a monolithic fp32 ``module.init`` would need —
+        transient fp32 peaks one segment, not the whole model."""
+        from ..models.causal_lm import causal_lm_segments
+        serve_dtype = self._config.jax_dtype()
+        segs = causal_lm_segments(cfg, layers_per_group=1)
+        rng = jax.random.PRNGKey(seed)
+        init_jits = {}
+        params = {}
+        for si, seg in enumerate(segs):
+            if not seg.init_keys:
+                continue
+            if seg.init_fn not in init_jits:
+                def casted(r, fn=seg.init_fn):
+                    return jax.tree_util.tree_map(
+                        lambda x: x.astype(serve_dtype)
+                        if x.dtype == jnp.float32 else x, fn(r))
+                init_jits[seg.init_fn] = jax.jit(casted)
+            sub = init_jits[seg.init_fn](jax.random.fold_in(rng, si))
+            for key, tree in zip(seg.init_keys, sub):
+                params[key] = tree
+        return params
 
     def _spec_fits(self, shape, spec) -> bool:
         mesh = self.mesh_spec

@@ -40,6 +40,12 @@ class GPT2Config:
     scan_layers: bool = True
     attention_impl: str = "auto"       # flash kernel on TPU, xla attention elsewhere
     init_std: float = 0.02
+    # Separate q/k/v projections instead of the fused c_attn. Required for in-stage
+    # tensor parallelism: separate (d, d) kernels shard their last dim into whole head
+    # groups, so the SAME global parameter layout is exact at every tp degree (a fused
+    # (d, 3d) kernel sharded contiguously would mix q/k/v columns per shard, making the
+    # model's meaning depend on tp — a silent checkpoint-portability hazard).
+    split_qkv: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -81,9 +87,17 @@ class Block(nn.Module):
         cfg = self.config
         attn = get_attention_impl(cfg.attention_impl)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(cfg.dtype)
-        qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn",
-                       kernel_init=nn.initializers.normal(cfg.init_std))(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if cfg.split_qkv:
+            q = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="q_attn",
+                         kernel_init=nn.initializers.normal(cfg.init_std))(h)
+            k = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="k_attn",
+                         kernel_init=nn.initializers.normal(cfg.init_std))(h)
+            v = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="v_attn",
+                         kernel_init=nn.initializers.normal(cfg.init_std))(h)
+        else:
+            qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn",
+                           kernel_init=nn.initializers.normal(cfg.init_std))(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
         b, t, _ = q.shape
         q = q.reshape(b, t, cfg.n_head, cfg.head_dim)
         k = k.reshape(b, t, cfg.n_head, cfg.head_dim)
@@ -107,6 +121,114 @@ class Block(nn.Module):
                      kernel_init=proj_init)(h)
         h = nn.Dropout(cfg.dropout, deterministic=deterministic)(h)
         return x + h
+
+
+# ------------------------------------------------------- manual tensor parallelism
+def _manual_layer_norm(p, x, eps: float = 1e-6):
+    """fp32 layernorm matching ``nn.LayerNorm(dtype=jnp.float32)`` numerics
+    (flax ``_compute_stats``: var = E[x²] − E[x]², clamped at 0)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    mean2 = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mean2 - jnp.square(mean))
+    mul = jax.lax.rsqrt(var + eps) * p["scale"].astype(jnp.float32)
+    return (x32 - mean) * mul + p["bias"].astype(jnp.float32)
+
+
+def _tp_conjugate_ops(axis: str):
+    """Megatron's f/g conjugate operators (megatron/mpu ``copy_to_model_parallel`` /
+    ``reduce_from_model_parallel``), defined via custom_vjp so the backward
+    collectives are EXPLICIT: under ``shard_map(check_vma=False)`` the raw ``psum``
+    transposes to another psum, which double-counts replicated cotangents.
+
+    - ``f``: identity forward, psum backward — enters a column-parallel region
+      (the replicated input's cotangent sums each shard's contribution);
+    - ``g``: psum forward, identity backward — exits a row-parallel region
+      (the summed output's cotangent is already replicated).
+    """
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, ct: (jax.lax.psum(ct, axis),))
+
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis)
+
+    g.defvjp(lambda x: (jax.lax.psum(x, axis), None), lambda _, ct: (ct,))
+    return f, g
+
+
+def block_tp_apply(cfg: GPT2Config, tp: int, axis: str):
+    """Megatron-style manual-collective Block forward for use INSIDE a ``shard_map``
+    whose manual axes include ``axis`` (reference 3D parallelism: TP inside pipeline
+    stages, ``runtime/pipe/topology.py:243``; column/row classification as in
+    ``module_inject/replace_module.py:25``).
+
+    The caller passes the LOCAL parameter shard: q/k/v + fc kernels column-sharded
+    (last dim, whole head groups), o/mlp projections row-sharded (first dim); the
+    f/g conjugate pair brackets each col→row sandwich — the two collectives per
+    block that Megatron inserts. Exactly equal to the replicated ``Block``
+    (``split_qkv=True``, dropout off) at any tp degree.
+
+    Returns ``fn(params_local, x, rng) -> y``.
+    """
+    assert cfg.split_qkv, "tensor-parallel Block needs split_qkv=True (see GPT2Config)"
+    assert cfg.n_head % tp == 0, (cfg.n_head, tp)
+    assert cfg.dropout == 0.0, "TP stage_fn does not implement attention dropout"
+    h_local = cfg.n_head // tp
+    dt = cfg.dtype
+    f_op, g_op = _tp_conjugate_ops(axis)
+
+    def dense(p, x):
+        return x @ p["kernel"].astype(dt) + p["bias"].astype(dt)
+
+    # honor cfg.attention_impl like the replicated Block does, with the manual-region
+    # constraint that only impls with a local (non-shard_map) form are usable
+    impl = cfg.attention_impl
+    if callable(impl) or impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            f"attention_impl={impl!r} has no manual-TP form inside the 1F1B "
+            "shard_map — use 'auto', 'xla', or 'flash' for TP pipeline bodies")
+
+    def attention(q, k, v):
+        from ..ops.transformer.attention import FLASH_MIN_SEQ, xla_attention
+        t = q.shape[1]
+        use_flash = (impl == "flash" or
+                     (impl == "auto" and jax.default_backend() == "tpu"
+                      and t >= FLASH_MIN_SEQ and t % 128 == 0))
+        if use_flash:
+            from ..ops.attention.flash import flash_attention_local
+            return flash_attention_local(q, k, v, causal=True)
+        return xla_attention(q, k, v, causal=True)
+
+    def apply(p, x, rng=None):
+        b, t, _ = x.shape
+        h = f_op(_manual_layer_norm(p["ln_1"], x).astype(dt))
+        q = dense(p["q_attn"], h).reshape(b, t, h_local, cfg.head_dim)
+        k = dense(p["k_attn"], h).reshape(b, t, h_local, cfg.head_dim)
+        v = dense(p["v_attn"], h).reshape(b, t, h_local, cfg.head_dim)
+        o = attention(q, k, v).reshape(b, t, h_local * cfg.head_dim)
+        # row-parallel projection: local partial matmul, g = psum-fwd/identity-bwd;
+        # bias is added once, after the reduction
+        o = g_op(o @ p["c_proj"]["kernel"].astype(dt)) + p["c_proj"]["bias"].astype(dt)
+        x = x + o
+        h = f_op(_manual_layer_norm(p["ln_2"], x).astype(dt))
+        h = nn.gelu(dense(p["c_fc"], h), approximate=True)
+        h = g_op(h @ p["mlp_c_proj"]["kernel"].astype(dt)) \
+            + p["mlp_c_proj"]["bias"].astype(dt)
+        return x + h
+
+    return apply
+
+
+# TP sharding roles of Block parameters (consumed by PipelineModule.param_specs):
+# column-parallel kernels shard their LAST dim (outputs = whole head groups / ffn
+# slices) and take their bias with them; row-parallel kernels shard their FIRST
+# weight dim (inputs), bias replicated.
+BLOCK_TP_COL = ("q_attn", "k_attn", "v_attn", "c_fc")
+BLOCK_TP_ROW = ("c_proj", "mlp_c_proj")
 
 
 class GPT2(nn.Module):

@@ -12,7 +12,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..runtime.pipe.module import FlaxPipeLayer, LayerSpec, PipelineModule, TiedLayerSpec
-from .gpt2 import Block, GPT2Config, cross_entropy_loss
+from .gpt2 import (BLOCK_TP_COL, BLOCK_TP_ROW, Block, GPT2Config, block_tp_apply,
+                   cross_entropy_loss)
 
 
 class GPT2EmbedPipe(nn.Module):
@@ -44,7 +45,12 @@ def _embed_layer(cfg):
 
 
 def _block_layer(cfg):
-    return FlaxPipeLayer(Block(cfg), deterministic_kwarg=True)
+    tp_factory = None
+    if cfg.split_qkv:
+        tp_factory = lambda tp, axis: block_tp_apply(cfg, tp, axis)
+    return FlaxPipeLayer(Block(cfg), deterministic_kwarg=True,
+                         tp_apply_factory=tp_factory,
+                         tp_col=BLOCK_TP_COL, tp_row=BLOCK_TP_ROW)
 
 
 def _norm_layer(cfg):

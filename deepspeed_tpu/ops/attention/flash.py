@@ -351,6 +351,22 @@ def _flash_core_bwd(scale, causal, block_q, block_k, res, do3):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+def flash_attention_local(q4, k4, v4, causal: bool = True,
+                          softmax_scale: Optional[float] = None,
+                          block_q: int = 512, block_k: int = 512):
+    """Per-shard kernel invocation with NO mesh dispatch — for callers already inside a
+    ``shard_map`` manual region (e.g. the TP pipeline stage_fn), where the public
+    :func:`flash_attention`'s own shard_map wrapper would illegally nest."""
+    lb, lt, lh, ld = q4.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(ld))
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(lb * lh, lt, ld)
+
+    o3 = _flash_core(to3(q4), to3(k4), to3(v4), scale, causal, block_q, block_k)
+    return o3.reshape(lb, lh, lt, ld).transpose(0, 2, 1, 3)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, mask: Optional[jnp.ndarray] = None,
                     softmax_scale: Optional[float] = None,
@@ -372,13 +388,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
 
     def local(q4, k4, v4):
-        lb, lt, lh, ld = q4.shape
-
-        def to3(x):
-            return x.transpose(0, 2, 1, 3).reshape(lb * lh, lt, ld)
-
-        o3 = _flash_core(to3(q4), to3(k4), to3(v4), scale, causal, block_q, block_k)
-        return o3.reshape(lb, lh, lt, ld).transpose(0, 2, 1, 3)
+        return flash_attention_local(q4, k4, v4, causal=causal, softmax_scale=scale,
+                                     block_q=block_q, block_k=block_k)
 
     # A pallas_call is opaque to the SPMD partitioner: under a sharded mesh it would force a
     # full rematerialisation. Run the kernel per-shard with shard_map over the batch (and TP

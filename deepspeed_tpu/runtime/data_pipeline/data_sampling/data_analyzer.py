@@ -1,0 +1,200 @@
+"""Offline difficulty-metric analysis — the producer of curriculum metric files.
+
+Behavioural equivalent of reference
+``deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py`` (``DataAnalyzer:18``):
+a map/reduce over the training corpus that computes per-sample difficulty metrics
+(sequence length, vocabulary rarity, ...) ahead of training, so the curriculum sampler
+(:class:`~.data_sampler.DeepSpeedDataSampler`) can gate eligibility without touching the
+model. Re-designed for the single-controller stack:
+
+- **map**: each worker computes its contiguous shard of the dataset and writes one
+  ``worker{i}.npz`` per metric (the reference writes per-thread mmap builders; plain
+  ``.npz`` shards hold the same content with numpy-native IO — the merge is
+  concatenation either way).
+- **reduce**: any process merges the worker files into the final artifacts:
+  ``{metric}/sample_to_metric.npy`` (per-sample values, the array the sampler
+  consumes), ``{metric}/metric_to_sample.npz`` (value → sample-id clusters, the
+  reference's reverse index), and ``{metric}/metric_value.npy`` for
+  ``accumulate_value_over_samples`` metrics.
+
+Metric functions take the COLLATED batch (whatever ``dataset[i]`` or ``collate_fn``
+yields) and return one value per sample — the reference's contract.
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ....utils.logging import logger
+
+METRIC_SINGLE = "single_value_per_sample"
+METRIC_ACCUMULATE = "accumulate_value_over_samples"
+
+
+class DataAnalyzer:
+    """Map/reduce difficulty metrics over a dataset.
+
+    ``num_workers``/``worker_id``: this process computes samples
+    ``[worker_id * n / num_workers, (worker_id + 1) * n / num_workers)``; each worker
+    calls :meth:`run_map`, then one process calls :meth:`run_reduce` once all worker
+    files exist (the reference uses the same split + merge contract).
+    """
+
+    def __init__(self, dataset: Sequence, metric_names: List[str],
+                 metric_functions: List[Callable], metric_types: List[str],
+                 num_workers: int = 1, worker_id: int = 0, batch_size: int = 64,
+                 save_path: str = "./data_analysis",
+                 collate_fn: Optional[Callable] = None,
+                 metric_dtypes: Optional[List[Any]] = None):
+        assert len(metric_names) == len(metric_functions) == len(metric_types)
+        assert 0 <= worker_id < num_workers
+        for t in metric_types:
+            assert t in (METRIC_SINGLE, METRIC_ACCUMULATE), t
+        self.dataset = dataset
+        self.metric_names = metric_names
+        self.metric_functions = metric_functions
+        self.metric_types = metric_types
+        self.metric_dtypes = metric_dtypes or [np.int64] * len(metric_names)
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+        self.save_path = save_path
+        self.collate_fn = collate_fn
+
+    # ------------------------------------------------------------------ map
+    def _shard_range(self):
+        n = len(self.dataset)
+        lo = self.worker_id * n // self.num_workers
+        hi = (self.worker_id + 1) * n // self.num_workers
+        return lo, hi
+
+    def _worker_file(self, metric: str, worker_id: int) -> str:
+        return os.path.join(self.save_path, metric, f"worker{worker_id}.npz")
+
+    def run_map(self):
+        """Compute this worker's shard; write one npz per metric."""
+        lo, hi = self._shard_range()
+        per_metric: List[List[np.ndarray]] = [[] for _ in self.metric_names]
+        for start in range(lo, hi, self.batch_size):
+            idxs = list(range(start, min(start + self.batch_size, hi)))
+            rows = [self.dataset[i] for i in idxs]
+            batch = self.collate_fn(rows) if self.collate_fn is not None else rows
+            for mi, fn in enumerate(self.metric_functions):
+                vals = np.asarray(fn(batch))
+                if self.metric_types[mi] == METRIC_SINGLE:
+                    assert vals.shape[0] == len(idxs), \
+                        (f"metric {self.metric_names[mi]!r} returned "
+                         f"{vals.shape[0]} values for {len(idxs)} samples")
+                per_metric[mi].append(vals)
+        for mi, name in enumerate(self.metric_names):
+            os.makedirs(os.path.join(self.save_path, name), exist_ok=True)
+            if self.metric_types[mi] == METRIC_SINGLE:
+                arr = (np.concatenate(per_metric[mi])
+                       if per_metric[mi] else np.zeros(0, self.metric_dtypes[mi]))
+                arr = arr.astype(self.metric_dtypes[mi])
+            else:
+                arr = np.sum([np.asarray(v) for v in per_metric[mi]], axis=0) \
+                    if per_metric[mi] else np.zeros((), self.metric_dtypes[mi])
+            np.savez(self._worker_file(name, self.worker_id),
+                     values=arr, lo=lo, hi=hi)
+        logger.info(f"DataAnalyzer map: worker {self.worker_id}/{self.num_workers} "
+                    f"wrote samples [{lo}, {hi}) for {len(self.metric_names)} metrics")
+
+    # ------------------------------------------------------------------ reduce
+    def run_reduce(self):
+        """Merge all workers' files into the final per-metric artifacts."""
+        n = len(self.dataset)
+        for mi, name in enumerate(self.metric_names):
+            shards = []
+            for w in range(self.num_workers):
+                f = self._worker_file(name, w)
+                assert os.path.isfile(f), \
+                    f"missing {f} — did worker {w} finish run_map()?"
+                shards.append(np.load(f))
+            mdir = os.path.join(self.save_path, name)
+            # the shards must stitch to exactly [0, n): a num_workers mismatch
+            # between map and reduce would otherwise ship silent zeros
+            ranges = sorted((int(s["lo"]), int(s["hi"])) for s in shards)
+            covered = ranges[0][0] == 0 and ranges[-1][1] == n and all(
+                a[1] == b[0] for a, b in zip(ranges, ranges[1:]))
+            assert covered, \
+                (f"worker shards {ranges} do not cover [0, {n}) — was run_map "
+                 f"executed with a different num_workers than this reduce?")
+            if self.metric_types[mi] == METRIC_SINGLE:
+                full = np.zeros(n, self.metric_dtypes[mi])
+                for s in shards:
+                    full[int(s["lo"]):int(s["hi"])] = s["values"]
+                np.save(os.path.join(mdir, "sample_to_metric.npy"), full)
+                # reverse index (reference metric_to_sample): value → sample ids,
+                # stored as one sorted permutation + cluster boundaries
+                order = np.argsort(full, kind="stable")
+                uniq, starts = np.unique(full[order], return_index=True)
+                np.savez(os.path.join(mdir, "metric_to_sample.npz"),
+                         values=uniq, starts=starts, sample_order=order)
+            else:
+                total = np.sum([s["values"] for s in shards], axis=0)
+                np.save(os.path.join(mdir, "metric_value.npy"), total)
+        with open(os.path.join(self.save_path, "analysis_meta.json"), "w") as f:
+            json.dump({"num_samples": n, "metrics": self.metric_names,
+                       "types": self.metric_types,
+                       "num_workers": self.num_workers}, f)
+        logger.info(f"DataAnalyzer reduce: merged {self.num_workers} workers over "
+                    f"{n} samples → {self.save_path}")
+
+
+def load_metric_values(save_path: str,
+                       metric_names: Optional[List[str]] = None
+                       ) -> Dict[str, np.ndarray]:
+    """Load reduced ``sample_to_metric`` arrays — the ``metric_values`` dict the
+    curriculum :class:`~.data_sampler.DeepSpeedDataSampler` consumes."""
+    if metric_names is None:
+        with open(os.path.join(save_path, "analysis_meta.json")) as f:
+            metric_names = json.load(f)["metrics"]
+    out = {}
+    for name in metric_names:
+        f = os.path.join(save_path, name, "sample_to_metric.npy")
+        if os.path.isfile(f):
+            out[name] = np.load(f)
+    return out
+
+
+# ------------------------------------------------------------------ stock metrics
+def _token_rows(batch):
+    """Normalise the accepted batch forms to a list of token arrays: a collated dict
+    of stacked ids, a list of per-sample dicts, or a list of raw arrays."""
+    if isinstance(batch, dict):
+        return list(np.asarray(batch["input_ids"]))
+    return [np.asarray(r["input_ids"] if isinstance(r, dict) else r)
+            for r in batch]
+
+
+def metric_seqlen(pad_token_id: int = 0) -> Callable:
+    """Per-sample non-pad token count — the reference's canonical curriculum metric
+    (``seqlen`` in the data-efficiency examples)."""
+    def fn(batch):
+        return np.asarray([int(np.sum(r != pad_token_id))
+                           for r in _token_rows(batch)], np.int64)
+    return fn
+
+
+def metric_vocab_rarity(vocab_size: int, token_counts: np.ndarray,
+                        pad_token_id: Optional[int] = 0) -> Callable:
+    """Mean negative-log-frequency of a sample's NON-PAD tokens (reference
+    ``vocabularyrarity``): higher = rarer vocabulary = harder sample. Padding is
+    excluded (it is the most frequent token by construction and would score heavily
+    padded samples 'easy' regardless of content); pass ``pad_token_id=None`` for
+    unpadded corpora. Values are scaled ×1e6 to integers, as the reference requires
+    integer metrics."""
+    freq = token_counts.astype(np.float64) / max(1.0, float(token_counts.sum()))
+    logf = -np.log(np.clip(freq, 1e-12, None))
+
+    def fn(batch):
+        out = []
+        for r in _token_rows(batch):
+            if pad_token_id is not None:
+                r = r[r != pad_token_id]
+            out.append(int(1e6 * float(np.mean(logf[r]))) if r.size else 0)
+        return np.asarray(out, np.int64)
+    return fn

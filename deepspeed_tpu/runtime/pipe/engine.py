@@ -42,13 +42,21 @@ class PipelineEngine(DeepSpeedEngine):
         assert cfg.mesh.pipe == model.num_stages, \
             (f"config mesh.pipe={cfg.mesh.pipe} != PipelineModule.num_stages="
              f"{model.num_stages}")
-        # NOTE: in-stage tensor parallelism of the body is NOT auto-enabled: XLA
-        # aborts compiling auto-tensor-sharded params inside the partial-manual 1F1B
-        # shard_map (manual axis = pipe). A tensor axis in the mesh is still usable —
-        # params replicate over it and other model parts may shard — but body-TP
-        # under the SPMD pipe needs a manual-collective stage_fn (future work; see
-        # PipelineModule.param_specs(tp_axis=...) for the spec-side support).
-        model_obj = model.to_model(mesh_spec=None, name=f"pipe{model.num_stages}")
+        # In-stage tensor parallelism: when the mesh has a tensor axis AND the body
+        # layer ships a manual-collective forward (tp_apply_factory — e.g. gpt2_pipe
+        # blocks with split_qkv=True), the 1F1B shard_map goes manual over
+        # {pipe, tensor} and body weights shard physically (Megatron col/row; the
+        # reference's 3D topology, pipe/topology.py:243). Bodies without a tp
+        # forward replicate over the tensor axis as before.
+        from ...parallel.mesh import AXIS_TENSOR
+        tp_axis = None
+        body_layer = model._layers[model.body_start]
+        if (getattr(cfg.mesh, "tensor", 1) or 1) > 1 \
+                and getattr(body_layer, "tp_apply_factory", None) is not None:
+            tp_axis = AXIS_TENSOR
+        model_obj = model.to_model(mesh_spec=None, name=f"pipe{model.num_stages}",
+                                   tp_axis=tp_axis,
+                                   tp_size=getattr(cfg.mesh, "tensor", None))
         super().__init__(args=args, model=model_obj, optimizer=optimizer,
                          model_parameters=model_parameters, training_data=training_data,
                          lr_scheduler=lr_scheduler, mpu=mpu, collate_fn=collate_fn,

