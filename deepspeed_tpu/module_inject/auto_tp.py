@@ -36,8 +36,12 @@ _NAMES = {
     "q": ("attn.q_proj", "self_attn.q_proj", "attention.q_proj", "q_proj"),
     "k": ("attn.k_proj", "self_attn.k_proj", "attention.k_proj", "k_proj"),
     "v": ("attn.v_proj", "self_attn.v_proj", "attention.v_proj", "v_proj"),
-    "qkv": ("attn.c_attn", "attention.query_key_value", "self_attention.query_key_value",
-            "attn.qkv_proj", "qkv_proj"),
+    # fused-qkv spellings are an ALLOWLIST of layouts this module provably splits
+    # correctly (gpt_bigcode family: MHA per-head interleaved / MQA-GQA contiguous,
+    # verified against HF logits). 'query_key_value' (falcon: per-kv-group interleave)
+    # and 'qkv_proj' (codegen: mp_num-blocked) are deliberately ABSENT — those
+    # layouts differ and must fail loud ("needs a named policy"), not mis-split.
+    "qkv": ("attn.c_attn",),
     "o": ("attn.c_proj", "self_attn.o_proj", "attention.o_proj", "o_proj",
           "self_attention.dense", "attn.out_proj", "self_attn.out_proj",
           "attention.dense"),
@@ -191,6 +195,7 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
          f"{cfg.n_layer}; keys sample: {list(sd)[:5]}")
 
     params: Dict[str, Any] = {}
+    trunk_left = set()
     for name, v in trunk.items():
         if any(name.endswith(e) for e in _EMBED):
             params["wte"] = jnp.asarray(_np(v))
@@ -200,8 +205,20 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
             params.setdefault("ln_f", {})["scale"] = jnp.asarray(_np(v))
         elif any(f"{ln}.bias" in name for ln in _FINAL_LN) and v.ndim == 1:
             params.setdefault("ln_f", {})["bias"] = jnp.asarray(_np(v))
-        elif name.endswith("lm_head.weight") and not cfg.tie_word_embeddings:
-            params["lm_head"] = {"kernel": jnp.asarray(_np(v).T)}
+        elif name.endswith("lm_head.weight"):
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = {"kernel": jnp.asarray(_np(v).T)}
+            # tied: the key is a duplicate view of wte — consumed either way
+        elif "inv_freq" in name or name.endswith("position_ids"):
+            pass   # rotary/positions buffers, not parameters
+        else:
+            trunk_left.add(name)
+    # same fail-loud census as the layer loop: silently dropping trunk params
+    # (embedding layernorms, differently-spelled heads) would serve wrong logits
+    if trunk_left:
+        raise ValueError(
+            f"auto-TP: unrecognised non-layer parameters {sorted(trunk_left)} — "
+            "this architecture needs a named policy")
     assert "wte" in params, f"auto-TP: no token embedding among {list(trunk)[:8]}"
     assert "ln_f" in params, f"auto-TP: no final norm among {list(trunk)[:8]}"
 

@@ -31,6 +31,8 @@ def _get_lib():
             lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
             lib.ds_aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
             lib.ds_aio_open.restype = ctypes.c_int
+            lib.ds_aio_open_direct.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.ds_aio_open_direct.restype = ctypes.c_int
             lib.ds_aio_close.argtypes = [ctypes.c_int]
             for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
                 fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
@@ -47,6 +49,27 @@ def aio_available() -> bool:
     return _get_lib() is not None
 
 
+O_DIRECT_ALIGN = 4096
+
+
+def aligned_array(n_bytes: int, dtype=np.uint8, align: int = O_DIRECT_ALIGN
+                  ) -> np.ndarray:
+    """Contiguous array of ``ceil(n_bytes/align)*align`` bytes whose data pointer is
+    ``align``-aligned — the buffer contract O_DIRECT imposes (reference allocates the
+    same via posix_memalign in deepspeed_aio_utils.cpp)."""
+    itemsize = np.dtype(dtype).itemsize
+    padded = -(-n_bytes // align) * align
+    raw = np.empty(padded + align, np.uint8)
+    shift = (-raw.ctypes.data) % align
+    # the returned view keeps ``raw`` alive through its .base chain
+    return raw[shift:shift + padded].view(dtype)
+
+
+def padded_len(n_elems: int, itemsize: int, align: int = O_DIRECT_ALIGN) -> int:
+    """Element count whose byte length rounds ``n_elems*itemsize`` up to ``align``."""
+    return (-(-(n_elems * itemsize) // align) * align) // itemsize
+
+
 class AsyncIOHandle:
     """Reference ``deepspeed_aio_handle_t`` surface: async_pread/async_pwrite/wait +
     sync convenience wrappers. Buffers must be contiguous writable numpy arrays and
@@ -60,18 +83,43 @@ class AsyncIOHandle:
 
     def __init__(self, thread_count: int = 1, block_size: int = 1 << 20,
                  queue_depth: int = 8, single_submit: bool = False,
-                 overlap_events: bool = True):
+                 overlap_events: bool = True, o_direct: bool = False):
         lib = _get_lib()
         if lib is None:
             raise RuntimeError("native aio op unavailable (no C++ toolchain?)")
         self._lib = lib
+        # O_DIRECT (reference deepspeed_aio_common.cpp O_DIRECT + io_submit): page-
+        # cache bypass for swap tiers bigger than RAM. Requires 4096-aligned
+        # buffers/offsets/lengths (see aligned_array/padded_len); downgrades to
+        # buffered per-filesystem when open(O_DIRECT) is refused (tmpfs).
+        self.o_direct = bool(o_direct)
+        if self.o_direct:
+            # chunk boundaries inherit block_size alignment — a non-4096-multiple
+            # block would make every chunk after the first start unaligned (EINVAL)
+            block_size = max(O_DIRECT_ALIGN,
+                             (int(block_size) // O_DIRECT_ALIGN) * O_DIRECT_ALIGN)
         self._h = lib.ds_aio_handle_new(int(thread_count), int(block_size))
         self._fds = {}
+        self._direct_warned = False
+
+    # errnos meaning "this filesystem does not support O_DIRECT" (vs unrelated
+    # open failures like ENOENT, which must surface through the buffered retry)
+    _DIRECT_REFUSED = (22, 95)   # EINVAL, EOPNOTSUPP
 
     def _fd(self, path: str, write: bool) -> int:
         key = (path, write)
         if key not in self._fds:
-            fd = self._lib.ds_aio_open(path.encode(), int(write))
+            fd = -1
+            if self.o_direct:
+                fd = self._lib.ds_aio_open_direct(path.encode(), int(write))
+                if fd < 0 and -fd in self._DIRECT_REFUSED \
+                        and not self._direct_warned:
+                    from ...utils.logging import logger
+                    logger.warning(f"aio: filesystem refused O_DIRECT for {path}; "
+                                   "falling back to buffered IO")
+                    self._direct_warned = True
+            if fd < 0:
+                fd = self._lib.ds_aio_open(path.encode(), int(write))
             if fd < 0:
                 raise OSError(f"aio: cannot open {path} (write={write})")
             self._fds[key] = fd

@@ -129,6 +129,24 @@ int ds_aio_open(const char* path, int for_write) {
   return open(path, flags, 0644);
 }
 
+int ds_aio_open_direct(const char* path, int for_write) {
+  // O_DIRECT bypasses the page cache (reference deepspeed_aio_common.cpp:76-116
+  // opens with O_DIRECT for its io_submit path): required for NVMe swap tiers whose
+  // working set exceeds RAM, where buffered IO double-copies and evicts. Caller
+  // guarantees 4096-aligned buffers/offsets/lengths. Returns -1 when the
+  // filesystem refuses O_DIRECT (e.g. tmpfs) — caller falls back to buffered.
+  // Returns the fd, or -errno so the caller can distinguish a genuine O_DIRECT
+  // refusal (EINVAL/EOPNOTSUPP) from unrelated failures (ENOENT, EACCES).
+#ifdef O_DIRECT
+  int flags = for_write ? (O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT) : (O_RDONLY | O_DIRECT);
+  int fd = open(path, flags, 0644);
+  return fd >= 0 ? fd : -errno;
+#else
+  (void)path; (void)for_write;
+  return -EINVAL;
+#endif
+}
+
 void ds_aio_close(int fd) { close(fd); }
 
 void ds_aio_pread(void* h, int fd, void* buf, int64_t nbytes, int64_t offset) {

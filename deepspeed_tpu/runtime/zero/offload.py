@@ -72,30 +72,42 @@ class _NVMeMomentStore:
 
     def __init__(self, path: str, masters, aio_config: dict):
         import os
-        from ...ops.aio.aio_handle import AsyncIOHandle, aio_available
+        from ...ops.aio.aio_handle import (AsyncIOHandle, aio_available,
+                                           aligned_array, padded_len)
         if not aio_available():
             raise RuntimeError("offload_optimizer.device=nvme requires the native "
                                "aio op (C++ toolchain)")
         os.makedirs(path, exist_ok=True)
         self.path = path
+        # O_DIRECT by default (page-cache bypass — the tier exists because the
+        # working set exceeds RAM); per-filesystem buffered fallback inside the handle
         self.handle = AsyncIOHandle(
             thread_count=aio_config.get("thread_count", 1),
             block_size=aio_config.get("block_size", 1 << 20),
-            queue_depth=aio_config.get("queue_depth", 8))
+            queue_depth=aio_config.get("queue_depth", 8),
+            o_direct=aio_config.get("use_o_direct", True))
+        self._padded_len = padded_len
         self.sizes = [int(m.size) for m in masters]
         self._files = [os.path.join(path, f"moments_leaf{i}.bin")
                        for i in range(len(masters))]
         max_size = max(self.sizes)
-        self._scratch = [np.empty(2 * max_size, np.float32) for _ in range(2)]
+        # 4096-aligned scratch with capacity padded to the O_DIRECT granularity
+        cap = padded_len(2 * max_size, 4)
+        self._scratch = [aligned_array(cap * 4, np.float32) for _ in range(2)]
         # lazy zero-init: a leaf whose file was never written reads as zeros from
         # the scratch fill — avoids a full-disk zero pass at startup that a
         # checkpoint resume would immediately overwrite anyway
         self._dirty = [False] * len(self.sizes)
 
+    def _io_len(self, i: int) -> int:
+        """Element count for leaf ``i``'s file IO (byte length 4096-padded for
+        O_DIRECT; the pad tail is scratch garbage both ways, never consumed)."""
+        return self._padded_len(2 * self.sizes[i], 4)
+
     def _fetch(self, i: int, buf: np.ndarray):
         """Start streaming leaf ``i``'s moments into ``buf`` (zeros if unwritten)."""
         if self._dirty[i]:
-            self.handle.async_pread(buf[:2 * self.sizes[i]], self._files[i])
+            self.handle.async_pread(buf[:self._io_len(i)], self._files[i])
         else:
             buf[:2 * self.sizes[i]] = 0.0
 
@@ -114,7 +126,7 @@ class _NVMeMomentStore:
             adam_step(masters[i], mv[:s], mv[s:2 * s], grads[i], lr,
                       betas[0], betas[1], eps, weight_decay, adam_w_mode, step,
                       bias_correction)
-            self.handle.async_pwrite(mv[:2 * s], self._files[i])
+            self.handle.async_pwrite(mv[:self._io_len(i)], self._files[i])
             self._dirty[i] = True
             self.handle.wait()
 
@@ -138,6 +150,13 @@ class _NVMeMomentStore:
             src = os.path.join(src_dir, os.path.basename(f))
             if os.path.isfile(src):
                 shutil.copy2(src, f)
+                # migrate pre-O_DIRECT checkpoints: old files are exactly 2·s·4
+                # bytes; pad to the 4096-aligned IO length so direct reads succeed
+                want = self._io_len(i) * 4
+                have = os.path.getsize(f)
+                if have < want:
+                    with open(f, "ab") as fh:
+                        fh.write(b"\0" * (want - have))
                 self._dirty[i] = True
             else:
                 # leaf absent from the checkpoint = it was all-zeros when saved;
@@ -149,20 +168,26 @@ class _NVMeMomentStore:
     def read_moments(self):
         """Materialise all moments in host RAM — tests/small models only; the
         engine's checkpoint path streams via :meth:`copy_files_to` instead."""
+        from ...ops.aio.aio_handle import aligned_array
         ms, vs = [], []
         for i, s in enumerate(self.sizes):
-            mv = np.zeros(2 * s, np.float32)
+            mv = aligned_array(self._io_len(i) * 4, np.float32)
+            mv[:] = 0.0
             if self._dirty[i]:
-                self.handle.sync_pread(mv, self._files[i])
+                self.handle.sync_pread(mv[:self._io_len(i)], self._files[i])
             ms.append(mv[:s].copy())
-            vs.append(mv[s:].copy())
+            vs.append(mv[s:2 * s].copy())
         return ms, vs
 
     def write_moments(self, ms, vs):
+        from ...ops.aio.aio_handle import aligned_array
         for i, (m, v) in enumerate(zip(ms, vs)):
-            mv = np.concatenate([np.asarray(m, np.float32).reshape(-1),
-                                 np.asarray(v, np.float32).reshape(-1)])
-            self.handle.sync_pwrite(mv, self._files[i])
+            s = self.sizes[i]
+            mv = aligned_array(self._io_len(i) * 4, np.float32)
+            mv[:s] = np.asarray(m, np.float32).reshape(-1)
+            mv[s:2 * s] = np.asarray(v, np.float32).reshape(-1)
+            mv[2 * s:] = 0.0
+            self.handle.sync_pwrite(mv[:self._io_len(i)], self._files[i])
             self._dirty[i] = True  # the next _fetch must READ, not zero-fill
 
 
