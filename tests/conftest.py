@@ -24,6 +24,12 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running convergence/perf lanes "
+        "(deselect with -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_mesh():
     """Tests that activate a mesh (engines, shard_map paths) must not leak it into
