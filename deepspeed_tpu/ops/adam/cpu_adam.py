@@ -143,15 +143,22 @@ class DeepSpeedCPUAdam:
         self.bias_correction = bias_correction
         self.step_count = 0
 
-    def step(self, grads: List[np.ndarray], lr: Optional[float] = None):
+    def step(self, grads: List[np.ndarray], lr: Optional[float] = None,
+             on_leaf_done=None):
+        """One optimizer step over every leaf. ``on_leaf_done(i)`` fires right after
+        leaf ``i``'s in-place update — the offload tier uses it to dispatch that
+        leaf's async H2D push while the NEXT leaf's SIMD Adam runs (reference
+        cpu_adam.cpp:21-57 tiles copy/compute the same way)."""
         assert len(grads) == len(self.params)
         self.step_count += 1
         lr = self.lr if lr is None else float(lr)
-        for p, m, v, g in zip(self.params, self.m, self.v, grads):
+        for i, (p, m, v, g) in enumerate(zip(self.params, self.m, self.v, grads)):
             adam_step(p, m, v, np.asarray(g, dtype=np.float32).reshape(-1),
                       lr, self.betas[0], self.betas[1], self.eps,
                       self.weight_decay, self.adamw_mode, self.step_count,
                       self.bias_correction)
+            if on_leaf_done is not None:
+                on_leaf_done(i)
 
     def state_dict(self) -> dict:
         return {"step": self.step_count, "m": self.m, "v": self.v}

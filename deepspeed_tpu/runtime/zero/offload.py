@@ -289,13 +289,17 @@ class OffloadOptimizerTier:
     def _cast_host(self, flat: np.ndarray, shape) -> np.ndarray:
         return cast_master_to(flat, shape, self.compute_dtype)
 
+    def _push_leaf(self, i: int):
+        """One leaf master → device (async dispatch), cast + placed per its spec.
+        Shared by the full push and the interleaved per-leaf path in :meth:`step`."""
+        return jax.device_put(self._cast_host(self.masters[i], self._shapes[i]),
+                              self._shardings[i])
+
     def _push(self) -> Any:
         """Masters → device, cast to compute dtype, placed per param shardings."""
         if self._partitioned:
             return self._push_partitioned()
-        outs = []
-        for master, shape, sh in zip(self.masters, self._shapes, self._shardings):
-            outs.append(jax.device_put(self._cast_host(master, shape), sh))
+        outs = [self._push_leaf(i) for i in range(len(self.masters))]
         return jax.tree_util.tree_unflatten(self._treedef, outs)
 
     def _push_partitioned(self) -> Any:
@@ -347,13 +351,31 @@ class OffloadOptimizerTier:
             self.step_count += 1
             self.nvme.adam_step_all(self.masters, grads, lr, self.step_count,
                                     **self._adam_kwargs)
-        elif self.kind == "adam":
-            self.opt.step(grads, lr=lr)
+            return self._push()
+        if self._partitioned:
+            if self.kind == "adam":
+                self.opt.step(grads, lr=lr)
+            else:
+                self.step_count += 1
+                for p, s, g in zip(self.masters, self.sq_sum, grads):
+                    adagrad_step(p, s, g, lr, self.eps, self.weight_decay)
+            return self._push()
+        # single-process RAM tier: interleave the async H2D push of leaf i with the
+        # SIMD update of leaf i+1 (reference cpu_adam.cpp tiles copy/compute; the
+        # round-2 review flagged the lockstep update-then-push as critical-path cost)
+        outs: List[Any] = [None] * len(self.masters)
+
+        def push_leaf(i: int):
+            outs[i] = self._push_leaf(i)
+
+        if self.kind == "adam":
+            self.opt.step(grads, lr=lr, on_leaf_done=push_leaf)
         else:
             self.step_count += 1
-            for p, s, g in zip(self.masters, self.sq_sum, grads):
+            for i, (p, s, g) in enumerate(zip(self.masters, self.sq_sum, grads)):
                 adagrad_step(p, s, g, lr, self.eps, self.weight_decay)
-        return self._push()
+                push_leaf(i)
+        return jax.tree_util.tree_unflatten(self._treedef, outs)
 
     def reseed_from_device(self, params_device: Any):
         """Overwrite masters from (compute-dtype) device params — fallback when loading a

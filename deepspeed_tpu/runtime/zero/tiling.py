@@ -38,7 +38,10 @@ class TiledDense(nn.Module):
     out_splits: int = 1
     use_bias: bool = True
     dtype: Any = None
-    kernel_init: Callable = nn.initializers.lecun_normal()
+    # None → lecun-normal CORRECTED for the tiling: each tile sees fan_in/in_splits,
+    # and summing in_splits independent partials multiplies output variance by
+    # in_splits — scale 1/in_splits² restores the monolithic Dense's init statistics
+    kernel_init: Optional[Callable] = None
     bias_init: Callable = nn.initializers.zeros
 
     @staticmethod
@@ -52,11 +55,13 @@ class TiledDense(nn.Module):
         in_b = self._bounds(in_dim, self.in_splits)
         out_b = self._bounds(self.features, self.out_splits)
         dt = self.dtype or x.dtype
+        kinit = self.kernel_init or nn.initializers.variance_scaling(
+            1.0 / self.in_splits**2, "fan_in", "truncated_normal")
         outs = []
         for oi, (o0, o1) in enumerate(out_b):
             acc = None
             for ii, (i0, i1) in enumerate(in_b):
-                k = self.param(f"kernel_{ii}_{oi}", self.kernel_init,
+                k = self.param(f"kernel_{ii}_{oi}", kinit,
                                (i1 - i0, o1 - o0), jnp.float32)
                 part = x[..., i0:i1].astype(dt) @ k.astype(dt)
                 acc = part if acc is None else acc + part
