@@ -353,7 +353,7 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def flash_attention_local(q4, k4, v4, causal: bool = True,
                           softmax_scale: Optional[float] = None,
-                          block_q: int = 512, block_k: int = 512):
+                          block_q: int = 1024, block_k: int = 1024):
     """Per-shard kernel invocation with NO mesh dispatch — for callers already inside a
     ``shard_map`` manual region (e.g. the TP pipeline stage_fn), where the public
     :func:`flash_attention`'s own shard_map wrapper would illegally nest."""
@@ -371,7 +371,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, mask: Optional[jnp.ndarray] = None,
                     softmax_scale: Optional[float] = None,
                     dropout_rate: float = 0.0, dropout_rng=None,
-                    block_q: int = 512, block_k: int = 512) -> jnp.ndarray:
+                    block_q: int = 1024, block_k: int = 1024) -> jnp.ndarray:
     """Drop-in replacement for ``xla_attention``: q/k/v ``(b, t, h, d)`` → ``(b, t, h, d)``.
 
     Falls back to the XLA path for features the kernel does not cover (arbitrary masks,
