@@ -348,20 +348,21 @@ def bench_inference_7b():
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--mode", choices=["train", "inference"], default="train")
+    p.add_argument("--mode", choices=["train", "inference"], default=None,
+                   help="defaults to the mode the chosen --model implies")
     p.add_argument("--model", choices=["default", "1.3b", "7b"], default="default",
                    help="north-star shapes: --model 1.3b (train, BASELINE config 3) "
                         "or --model 7b (inference, BASELINE config 5)")
     args = p.parse_args()
     if args.model == "1.3b":
-        if args.mode != "train":
-            p.error("--model 1.3b is a training benchmark (--mode train)")
+        if args.mode == "inference":
+            p.error("--model 1.3b is a training benchmark")
         bench_train_13b()
     elif args.model == "7b":
         if args.mode == "train":
-            p.error("--model 7b is an inference benchmark (--mode inference)")
+            p.error("--model 7b is an inference benchmark")
         bench_inference_7b()
-    elif args.mode == "train":
+    elif (args.mode or "train") == "train":
         bench_train()
     else:
         bench_inference()
