@@ -182,8 +182,12 @@ class DeepSpeedCheckpoint:
             param_shapes = [param_shapes]
         opt_sds = [_torch_load(f)[OPTIMIZER_STATE_DICT] for f in self.zero_files]
         stage = opt_sds[0].get(ZERO_STAGE, 1)
-        paddings = opt_sds[0].get(GROUP_PADDINGS,
-                                  [0] * len(param_shapes))
+        # the reference records group_paddings per-rank and only the LAST dp rank's
+        # partition is padded (stage_1_and_2.py:333-339 sets 0 for all earlier
+        # ranks), so the concatenated flat group's trailing pad lives in the last
+        # shard — read the paddings from there
+        paddings = opt_sds[-1].get(GROUP_PADDINGS,
+                                   [0] * len(param_shapes))
         out: Dict[str, np.ndarray] = {}
         for gi, group_shapes in enumerate(param_shapes):
             flat = np.concatenate(

@@ -38,9 +38,10 @@ class TiledDense(nn.Module):
     out_splits: int = 1
     use_bias: bool = True
     dtype: Any = None
-    # None → lecun-normal CORRECTED for the tiling: each tile sees fan_in/in_splits,
-    # and summing in_splits independent partials multiplies output variance by
-    # in_splits — scale 1/in_splits² restores the monolithic Dense's init statistics
+    # None → lecun-normal CORRECTED for the tiling: variance_scaling already divides
+    # by each tile's fan_in (= fan_in/in_splits), so one partial's output variance
+    # matches the monolithic Dense; summing in_splits independent partials then
+    # multiplies variance by in_splits — scale 1/in_splits restores Dense's stats
     kernel_init: Optional[Callable] = None
     bias_init: Callable = nn.initializers.zeros
 
@@ -56,7 +57,7 @@ class TiledDense(nn.Module):
         out_b = self._bounds(self.features, self.out_splits)
         dt = self.dtype or x.dtype
         kinit = self.kernel_init or nn.initializers.variance_scaling(
-            1.0 / self.in_splits**2, "fan_in", "truncated_normal")
+            1.0 / self.in_splits, "fan_in", "truncated_normal")
         outs = []
         for oi, (o0, o1) in enumerate(out_b):
             acc = None

@@ -175,7 +175,10 @@ class TestZeroReconstruct:
     def test_fp32_from_partitions(self, tmp_path):
         """zero_pp_rank_* fp32 flat partitions + mp_rank param_shapes → full fp32."""
         rng = np.random.RandomState(0)
-        shapes = OrderedDict([("w1", (4, 3)), ("b1", (4,)), ("w2", (2, 4))])
+        # total (29) deliberately NOT divisible by dp so the last-rank padding
+        # path is actually exercised (pad = 1)
+        shapes = OrderedDict([("w1", (4, 3)), ("b1", (4,)), ("w2", (2, 4)),
+                              ("b2", (5,))])
         total = sum(int(np.prod(s)) for s in shapes.values())
         flat = rng.standard_normal(total).astype(np.float32)
         dp = 2
@@ -185,9 +188,12 @@ class TestZeroReconstruct:
         torch.save({"param_shapes": shapes, "iteration": 7},
                    os.path.join(tmp_path, "mp_rank_00_model_states.pt"))
         for r in range(dp):
+            # reference layout: padding is recorded (and nonzero) only on the LAST
+            # dp rank's shard (stage_1_and_2.py:333-339)
             torch.save({"optimizer_state_dict": {
                 "single_partition_of_fp32_groups": [torch.tensor(parts[r])],
-                "zero_stage": 2, "group_paddings": [pad],
+                "zero_stage": 2,
+                "group_paddings": [pad if r == dp - 1 else 0],
                 "partition_count": dp}},
                 os.path.join(tmp_path, f"zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
 

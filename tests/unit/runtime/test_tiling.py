@@ -37,6 +37,27 @@ class TestTiledDense:
         total = sum(int(np.prod(k.shape)) for k in kernels)
         assert total == 64 * 100
 
+    @pytest.mark.parametrize("in_splits", [2, 4])
+    def test_fresh_init_variance_matches_dense(self, in_splits):
+        """Default init statistics must match monolithic nn.Dense: summing in_splits
+        independent lecun-scaled partials needs a 1/in_splits variance correction
+        (advisor r3: 1/in_splits**2 under-scaled output std by sqrt(in_splits))."""
+        import flax.linen as nn
+        d_in, d_out, n = 256, 256, 512
+        x = jnp.asarray(np.random.RandomState(0).standard_normal((n, d_in)),
+                        jnp.float32)
+        dense = nn.Dense(d_out, use_bias=False)
+        tiled = TiledDense(features=d_out, in_splits=in_splits, use_bias=False)
+        stds_d, stds_t = [], []
+        for seed in range(4):
+            key = jax.random.PRNGKey(seed)
+            yd = dense.apply(dense.init(key, x), x)
+            yt = tiled.apply(tiled.init(key, x), x)
+            stds_d.append(float(jnp.std(yd)))
+            stds_t.append(float(jnp.std(yt)))
+        ratio = np.mean(stds_t) / np.mean(stds_d)
+        assert 0.9 < ratio < 1.1, (ratio, stds_d, stds_t)
+
     def test_uneven_splits(self):
         tiled = TiledDense(features=7, in_splits=3, out_splits=2, use_bias=False)
         x = jnp.asarray(np.random.RandomState(1).standard_normal((2, 11)),

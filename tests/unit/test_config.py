@@ -109,13 +109,24 @@ def test_mesh_block():
     assert cfg.mesh.data == -1
 
 
-def test_offload_param_rejected_loudly():
-    """No phantom configs: unimplemented parameter offload raises instead of being
-    silently ignored (round-1 VERDICT weak item 4)."""
+def test_offload_param_error_contracts():
+    """No phantom configs: offload_param's preconditions fail loudly instead of the
+    flag being silently ignored (reference requires stage 3 for parameter
+    partitioning, deepspeed/runtime/zero/partition_parameters.py:539; the streaming
+    tier additionally needs a segmented model to bound resident HBM)."""
     import pytest
     import deepspeed_tpu
     from tests.unit.simple_model import base_config, simple_model
+
+    # offload_param outside ZeRO stage 3 is rejected
+    cfg = base_config(batch_size=16, stage=2)
+    cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
+    with pytest.raises(ValueError, match="stage 3"):
+        deepspeed_tpu.initialize(model=simple_model(16), config=cfg)
+
+    # offload_param on a model with no segment decomposition is rejected: the
+    # streaming coordinator needs Model.segments to bound peak resident HBM
     cfg = base_config(batch_size=16, stage=3)
     cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
-    with pytest.raises(NotImplementedError, match="offload_param"):
+    with pytest.raises(ValueError, match="segment"):
         deepspeed_tpu.initialize(model=simple_model(16), config=cfg)
