@@ -334,6 +334,61 @@ def bench_inference_7b():
         _sync(engine.generate(ids, max_new_tokens=4))
         ttfts.append(max(engine.ttft - rtt, 1e-9))
     ttft_p50 = sorted(ttfts)[len(ttfts) // 2] * 1e3
+
+    # Pure prefill execution time by k-differencing (cancels dispatch/RTT exactly):
+    # k sequential prefill dispatches, fetch the last token — (T_k2 - T_k1)/(k2 - k1).
+    from deepspeed_tpu.models.causal_lm import init_cache
+    prefill, _ = engine._loop_fns(False, 1.0, 0, 1.0, prompt_len + 64)
+    caches = init_cache(engine.model_config, batch, prompt_len + 64,
+                        dtype=engine.dtype)
+    lens0 = jnp_.full((batch,), prompt_len, jnp_.int32)
+    ids_dev = jnp_.asarray(ids)
+    key = jax.random.PRNGKey(0)
+
+    def prefill_k(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            tok0, _, _ = prefill(engine.params, ids_dev, caches, lens0, key)
+        _sync(tok0)
+        return time.perf_counter() - t0
+
+    prefill_k(1)
+    exec_ms = []
+    for _ in range(iters):
+        t1, t9 = prefill_k(1), prefill_k(9)
+        exec_ms.append((t9 - t1) / 8 * 1e3)
+    prefill_exec_p50 = sorted(exec_ms)[len(exec_ms) // 2]
+
+    # Steady-state decode tokens/s by generation-length differencing (same
+    # methodology as bench_inference): cancels prefill + all constant overhead.
+    short_len, long_len = 16, 64
+    _sync(engine.generate(ids, max_new_tokens=short_len))
+    _sync(engine.generate(ids, max_new_tokens=long_len))
+    decode_tps = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(engine.generate(ids, max_new_tokens=long_len))
+        dt_long = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _sync(engine.generate(ids, max_new_tokens=short_len))
+        dt_short = time.perf_counter() - t0
+        per_token = max(dt_long - dt_short, 1e-9) / (long_len - short_len)
+        decode_tps.append(batch / per_token)
+    decode_p50 = sorted(decode_tps)[len(decode_tps) // 2]
+
+    # Executed prefill matmul FLOPs: the head (tied wte, v*d params) runs at ONE
+    # position (logits_positions), not all prompt_len — billing it per-position
+    # would overstate MFU by ~1.14x at BLOOM's 250k vocab.
+    vd = cfg.vocab_size * cfg.n_embd
+    flops_prefill = 2.0 * ((cfg.num_params() - vd) * prompt_len + vd)
+    prefill_tflops = flops_prefill / (prefill_exec_p50 / 1e3) / 1e12
+    peak = peak_tflops()
+    # Headline keeps the round-3 methodology (single-shot TTFT minus one measured
+    # dispatch RTT) for longitudinal comparability; prefill_exec_p50_ms is the
+    # k-differenced on-device execution time (cancels dispatch/RTT exactly — the
+    # TTFT a production deployment observes, and the basis for prefill_mfu). On the
+    # tunnel the corrected single-shot's residual is RTT jitter (~±15 ms) and can
+    # even undershoot the differenced figure.
     out = {
         "metric": "bloom_7b_bf16_prefill_ttft_p50_ms",
         "value": round(ttft_p50, 2),
@@ -342,7 +397,12 @@ def bench_inference_7b():
         "params": cfg.num_params(),
         "prompt_len": prompt_len,
         "dispatch_rtt_ms": round(rtt * 1e3, 2),
+        "prefill_exec_p50_ms": round(prefill_exec_p50, 2),
+        "prefill_tflops": round(prefill_tflops, 1),
+        "decode_tokens_per_sec": round(decode_p50, 2),
     }
+    if peak:
+        out["prefill_mfu"] = round(prefill_tflops / peak, 4)
     print(json.dumps(out))
 
 

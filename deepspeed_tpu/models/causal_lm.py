@@ -333,14 +333,7 @@ class CausalLMLayer(nn.Module):
             o = _sharded_decode(q[:, 0], k_cache, v_cache, cache_len + 1,
                                 alibi=slopes)[:, None]
         else:
-            bias = None
-            if slopes is not None:
-                # (h, t, s) alibi bias: slope * -(row - col), 0 on diagonal
-                rows = jnp.arange(t)[:, None]
-                cols = jnp.arange(t)[None, :]
-                bias = (slopes[:, None, None] *
-                        (cols - rows)[None].astype(jnp.float32))
-            o = _bias_attention(q, k, v, bias)
+            o = _bias_attention(q, k, v, slopes)
             if cache is not None:
                 # prefill: write the prompt's K/V (post-rotary) into the fixed cache
                 T = cache["k"].shape[2]
@@ -365,18 +358,35 @@ class CausalLMLayer(nn.Module):
         return y, new_kv
 
 
-def _bias_attention(q, k, v, bias):
-    """Full-sequence causal attention with optional additive (h, t, s) bias (alibi)."""
+def _bias_attention(q, k, v, slopes):
+    """Full-sequence causal attention, optionally with per-head alibi slopes.
+
+    The alibi bias rides INSIDE the Pallas flash kernel (no (h, t, s) bias tensor in
+    HBM — the reference fuses the same bias into ``softmax_kernels.cu``); tiny or
+    non-128-aligned lengths take the XLA einsum path where block padding would
+    dominate the kernel."""
+    from ..ops.attention.flash import flash_attention
+    from ..ops.transformer.attention import flash_eligible
     if k.shape[2] != q.shape[2]:  # GQA prefill: broadcast kv heads to query heads
         g = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, g, axis=2)
         v = jnp.repeat(v, g, axis=2)
-    if bias is None:
-        from ..ops.attention.flash import flash_attention
+    if slopes is None:
         return flash_attention(q, k, v, causal=True)
+    if flash_eligible(q.shape[1]):
+        return flash_attention(q, k, v, causal=True, alibi_slopes=slopes)
+    return _alibi_attention_xla(q, k, v, slopes)
+
+
+def _alibi_attention_xla(q, k, v, slopes):
+    """XLA reference path for alibi attention (short/unaligned sequences; also the
+    numerical reference the flash-alibi kernel is tested against)."""
     d = q.shape[-1]
     scale = 1.0 / float(np.sqrt(d))
     t, s = q.shape[1], k.shape[1]
+    rows = jnp.arange(t)[:, None]
+    cols = jnp.arange(s)[None, :]
+    bias = slopes[:, None, None] * (cols - rows)[None].astype(jnp.float32)
     logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
     logits = logits + bias[None]
     causal = jnp.tril(jnp.ones((t, s), dtype=bool))

@@ -87,8 +87,12 @@ def _q_index_map(causal, bq, bk, extra_dims=0):
 
 
 # ----------------------------------------------------------------------- forward kernel
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, nk, bq, bk, t_valid):
+def _fwd_kernel(*refs, scale, causal, use_alibi, nk, bq, bk, t_valid):
+    if use_alibi:
+        q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        slopes_ref = None
     j = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -111,6 +115,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                                 preferred_element_type=jnp.float32) * scale
         rows = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if use_alibi:
+            # per-head additive bias slope*(col-row) — 0 on the diagonal, negative
+            # below (alibi distance penalty; masked positions are overwritten next)
+            s = s + slopes_ref[0, 0, 0] * (cols - rows).astype(jnp.float32)
         mask = cols < t_valid
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -138,24 +146,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid):
-    """q3/k3/v3: (bh, t, d) padded to block multiples. Returns (o3, lse (bh, t))."""
+def _flash_fwd(q3, k3, v3, slopes3, scale, causal, block_q, block_k, t_valid):
+    """q3/k3/v3: (bh, t, d) padded to block multiples; slopes3: per-(b·h) alibi
+    slopes broadcast to (bh, 8, 128) for lane alignment, or None.
+    Returns (o3, lse (bh, t))."""
     bh, t, d = q3.shape
     bq, bk = _block_sizes(t, block_q, block_k)
     nq, nk = t // bq, t // bk
     grid = (bh, nq, nk)
+    use_alibi = slopes3 is not None
 
     k_index = _k_index_map(causal, bq, bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               nk=nk, bq=bq, bk=bk, t_valid=t_valid)
+                               use_alibi=use_alibi, nk=nk, bq=bq, bk=bk,
+                               t_valid=t_valid)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), k_index),
+        pl.BlockSpec((1, bk, d), k_index),
+    ]
+    args = [q3, k3, v3]
+    if use_alibi:
+        in_specs.append(pl.BlockSpec((1, 8, 128), lambda i, j, kb: (i, 0, 0)))
+        args.append(slopes3)
     o3, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), k_index),
-            pl.BlockSpec((1, bk, d), k_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
             pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
@@ -172,13 +189,18 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(q3, k3, v3)
+    )(*args)
     return o3, lse[:, :, 0, :].reshape(bh, t)
 
 
 # ---------------------------------------------------------------------- backward kernels
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-                   *, scale, causal, nk, bq, bk, t_valid):
+def _bwd_dq_kernel(*refs, scale, causal, use_alibi, nk, bq, bk, t_valid):
+    if use_alibi:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+        slopes_ref = None
     j = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -202,6 +224,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
                                 preferred_element_type=jnp.float32) * scale
         rows = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if use_alibi:
+            s = s + slopes_ref[0, 0, 0] * (cols - rows).astype(jnp.float32)
         mask = cols < t_valid
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -219,8 +243,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         dq_ref[0] = dq_scr[0].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    dk_scr, dv_scr, *, scale, causal, nq, bq, bk, t_valid):
+def _bwd_dkv_kernel(*refs, scale, causal, use_alibi, nq, bq, bk, t_valid):
+    if use_alibi:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        slopes_ref = None
     kb = pl.program_id(1)
     qb = pl.program_id(2)
 
@@ -245,6 +275,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
                                 preferred_element_type=jnp.float32) * scale
         rows = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if use_alibi:
+            s = s + slopes_ref[0, 0, 0] * (cols - rows).astype(jnp.float32)
         mask = cols < t_valid
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -266,49 +298,61 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0] = dv_scr[0].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, t_valid):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, slopes3, scale, causal, block_q, block_k,
+               t_valid):
     bh, t, d = q3.shape
     bq, bk = _block_sizes(t, block_q, block_k)
     nq, nk = t // bq, t // bk
+    use_alibi = slopes3 is not None
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)  # (bh, t)
     lse_b = jnp.broadcast_to(lse.reshape(bh, nq, 1, bq), (bh, nq, 8, bq))
     delta_b = jnp.broadcast_to(delta.reshape(bh, nq, 1, bq), (bh, nq, 8, bq))
 
     k_index = _k_index_map(causal, bq, bk)
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+        pl.BlockSpec((1, bk, d), k_index),
+        pl.BlockSpec((1, bk, d), k_index),
+        pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+        pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
+        pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
+    ]
+    dq_args = [q3, k3, v3, do3, lse_b, delta_b]
+    if use_alibi:
+        dq_in_specs.append(pl.BlockSpec((1, 8, 128), lambda i, j, kb: (i, 0, 0)))
+        dq_args.append(slopes3)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, nk=nk,
-                          bq=bq, bk=bk, t_valid=t_valid),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          use_alibi=use_alibi, nk=nk, bq=bq, bk=bk, t_valid=t_valid),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), k_index),
-            pl.BlockSpec((1, bk, d), k_index),
-            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, 8, bq), lambda i, j, kb: (i, j, 0, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((1, bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse_b, delta_b)
+    )(*dq_args)
 
     q_index = _q_index_map(causal, bq, bk)
     lse_index = _q_index_map(causal, bq, bk, extra_dims=1)
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, d), q_index),
+        pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
+        pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
+        pl.BlockSpec((1, bq, d), q_index),
+        pl.BlockSpec((1, 1, 8, bq), lse_index),
+        pl.BlockSpec((1, 1, 8, bq), lse_index),
+    ]
+    dkv_args = [q3, k3, v3, do3, lse_b, delta_b]
+    if use_alibi:
+        dkv_in_specs.append(pl.BlockSpec((1, 8, 128), lambda i, kb, qb: (i, 0, 0)))
+        dkv_args.append(slopes3)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, nq=nq,
-                          bq=bq, bk=bk, t_valid=t_valid),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          use_alibi=use_alibi, nq=nq, bq=bq, bk=bk, t_valid=t_valid),
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), q_index),
-            pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
-            pl.BlockSpec((1, bq, d), q_index),
-            pl.BlockSpec((1, 1, 8, bq), lse_index),
-            pl.BlockSpec((1, 1, 8, bq), lse_index),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
             pl.BlockSpec((1, bk, d), lambda i, kb, qb: (i, kb, 0)),
@@ -322,48 +366,66 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, t_vali
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse_b, delta_b)
+    )(*dkv_args)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------------------- public op
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q3, k3, v3, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q3, k3, v3, slopes3, scale, causal, use_alibi, block_q, block_k):
     t_valid = q3.shape[1]
-    o3, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid)
+    o3, _ = _flash_fwd(q3, k3, v3, slopes3 if use_alibi else None, scale, causal,
+                       block_q, block_k, t_valid)
     return o3
 
 
-def _flash_core_fwd(q3, k3, v3, scale, causal, block_q, block_k):
+def _flash_core_fwd(q3, k3, v3, slopes3, scale, causal, use_alibi, block_q, block_k):
     t_valid = q3.shape[1]
-    o3, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, t_valid)
-    return o3, (q3, k3, v3, o3, lse)
+    o3, lse = _flash_fwd(q3, k3, v3, slopes3 if use_alibi else None, scale, causal,
+                         block_q, block_k, t_valid)
+    return o3, (q3, k3, v3, o3, lse, slopes3)
 
 
-def _flash_core_bwd(scale, causal, block_q, block_k, res, do3):
-    q3, k3, v3, o3, lse = res
+def _flash_core_bwd(scale, causal, use_alibi, block_q, block_k, res, do3):
+    q3, k3, v3, o3, lse, slopes3 = res
     t_valid = q3.shape[1]
-    dq, dk, dv = _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal,
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o3, lse, do3,
+                            slopes3 if use_alibi else None, scale, causal,
                             block_q, block_k, t_valid)
-    return dq, dk, dv
+    # alibi slopes are a fixed schedule, not trained — zero cotangent
+    return dq, dk, dv, jnp.zeros_like(slopes3)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
+_DUMMY_SLOPES = np.zeros((1, 8, 128), np.float32)
+
+
+def _slopes3(alibi_slopes, b, h):
+    """(h,) per-head slopes → (b*h, 8, 128) f32 (value duplicated for TPU lane
+    alignment; the kernel reads element [0, 0, 0] of each head's block)."""
+    s = jnp.tile(jnp.asarray(alibi_slopes, jnp.float32), b)       # bh = bi*h + hi
+    return jnp.broadcast_to(s[:, None, None], (b * h, 8, 128))
+
 
 def flash_attention_local(q4, k4, v4, causal: bool = True,
                           softmax_scale: Optional[float] = None,
+                          alibi_slopes: Optional[jnp.ndarray] = None,
                           block_q: int = 1024, block_k: int = 1024):
     """Per-shard kernel invocation with NO mesh dispatch — for callers already inside a
     ``shard_map`` manual region (e.g. the TP pipeline stage_fn), where the public
     :func:`flash_attention`'s own shard_map wrapper would illegally nest."""
     lb, lt, lh, ld = q4.shape
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(ld))
+    use_alibi = alibi_slopes is not None
+    slopes3 = (_slopes3(alibi_slopes, lb, lh) if use_alibi
+               else jnp.asarray(_DUMMY_SLOPES))
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(lb * lh, lt, ld)
 
-    o3 = _flash_core(to3(q4), to3(k4), to3(v4), scale, causal, block_q, block_k)
+    o3 = _flash_core(to3(q4), to3(k4), to3(v4), slopes3, scale, causal, use_alibi,
+                     block_q, block_k)
     return o3.reshape(lb, lh, lt, ld).transpose(0, 2, 1, 3)
 
 
@@ -371,8 +433,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True, mask: Optional[jnp.ndarray] = None,
                     softmax_scale: Optional[float] = None,
                     dropout_rate: float = 0.0, dropout_rng=None,
+                    alibi_slopes: Optional[jnp.ndarray] = None,
                     block_q: int = 1024, block_k: int = 1024) -> jnp.ndarray:
     """Drop-in replacement for ``xla_attention``: q/k/v ``(b, t, h, d)`` → ``(b, t, h, d)``.
+
+    ``alibi_slopes`` (h,) adds the per-head alibi distance bias ``slope*(col-row)``
+    inside the kernel (BLOOM; reference fuses the same bias into its attn_softmax
+    kernel, ``softmax_kernels.cu``) — no (h, t, s) bias tensor is ever materialised.
 
     Falls back to the XLA path for features the kernel does not cover (arbitrary masks,
     attention dropout, cross-attention with different kv length). There is no
@@ -381,14 +448,20 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     from ..transformer.attention import xla_attention
     if mask is not None or dropout_rate > 0.0 or q.shape[1] != k.shape[1]:
+        if alibi_slopes is not None:
+            raise NotImplementedError(
+                "alibi_slopes is kernel-only: combine it with mask/dropout/"
+                "cross-attention via the model-level XLA bias path instead")
         return xla_attention(q, k, v, causal=causal, mask=mask,
                              softmax_scale=softmax_scale,
                              dropout_rate=dropout_rate, dropout_rng=dropout_rng)
     b, t, h, d = q.shape
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    use_alibi = alibi_slopes is not None
 
-    def local(q4, k4, v4):
+    def local(q4, k4, v4, slopes=None):
         return flash_attention_local(q4, k4, v4, causal=causal, softmax_scale=scale,
+                                     alibi_slopes=slopes,
                                      block_q=block_q, block_k=block_k)
 
     # A pallas_call is opaque to the SPMD partitioner: under a sharded mesh it would force a
@@ -404,8 +477,18 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         manual = set(batch_axes) | ({AXIS_TENSOR} if use_tp else set())
         if manual and b % max(bsz, 1) == 0:
             spec = P(batch_axes or None, None, AXIS_TENSOR if use_tp else None, None)
+            if use_alibi:
+                # slopes shard over the head (TP) axis: each shard sees its heads'
+                sspec = P(AXIS_TENSOR if use_tp else None)
+                mapped = jax.shard_map(
+                    lambda q4, k4, v4, s: local(q4, k4, v4, s),
+                    mesh=mesh.mesh, axis_names=manual,
+                    in_specs=(spec,) * 3 + (sspec,), out_specs=spec,
+                    check_vma=False)
+                return mapped(q, k, v, jnp.asarray(alibi_slopes, jnp.float32))
             mapped = jax.shard_map(local, mesh=mesh.mesh, axis_names=manual,
                                    in_specs=(spec,) * 3, out_specs=spec,
                                    check_vma=False)
             return mapped(q, k, v)
-    return local(q, k, v)
+    return local(q, k, v, jnp.asarray(alibi_slopes, jnp.float32) if use_alibi
+                 else None)

@@ -54,10 +54,15 @@ def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 FLASH_MIN_SEQ = 256
 
 
+def flash_eligible(t: int) -> bool:
+    """Kernel-eligibility rule shared by every flash-vs-XLA dispatch site:
+    t % 128 != 0 degrades ``_block_sizes`` to tiny MXU-starved blocks, and below
+    ``FLASH_MIN_SEQ`` block padding dominates — those shapes stay on XLA."""
+    return t >= FLASH_MIN_SEQ and t % 128 == 0
+
+
 def _auto_attention(q, k, v, **kw):
-    # t % 128: non-aligned lengths degrade _block_sizes to tiny MXU-starved blocks —
-    # those shapes stay on XLA (the measured wins are on 128-multiple lengths)
-    if q.shape[1] >= FLASH_MIN_SEQ and q.shape[1] % 128 == 0:
+    if flash_eligible(q.shape[1]):
         from ..attention.flash import flash_attention
         return flash_attention(q, k, v, **kw)
     return xla_attention(q, k, v, **kw)
