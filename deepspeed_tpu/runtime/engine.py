@@ -422,15 +422,16 @@ class DeepSpeedEngine:
         op_cfg = self._config.zero_config.offload_param
         off_opt = self._config.zero_config.offload_optimizer
         nvme_path = None
-        # no phantom config keys: parameter MASTERS on NVMe is not implemented (they
-        # stay in host RAM) — accepting device='nvme' for offload_param would promise
-        # a model-larger-than-host-RAM capability this tier does not have. Moments on
-        # NVMe come from offload_optimizer.device='nvme' (ZeRO-Infinity tier).
+        nvme_param_path = None
+        # full ZeRO-Infinity: parameter masters (+ gradient accumulators) stream
+        # from NVMe per model segment (reference partitioned_param_swapper.py:35);
+        # implies the moment store on disk too
         if op_cfg.device == "nvme":
-            raise NotImplementedError(
-                "offload_param.device='nvme' (parameter masters on disk) is not "
-                "implemented — use offload_param.device='cpu' with "
-                "offload_optimizer.device='nvme' to put the Adam moments on disk")
+            if not op_cfg.nvme_path:
+                raise ValueError("offload_param device=nvme requires nvme_path")
+            if kind != "adam":
+                raise ValueError("nvme offload supports adam/adamw only")
+            nvme_param_path = op_cfg.nvme_path
         if off_opt is not None and off_opt.device == "nvme":
             if not off_opt.nvme_path:
                 raise ValueError("offload_optimizer device=nvme requires nvme_path")
@@ -446,7 +447,7 @@ class DeepSpeedEngine:
             gradient_clipping=self._config.gradient_clipping or 0.0,
             fp16_enabled=self._config.fp16.enabled,
             loss_scaler=self.loss_scaler, scaler_state=scaler_state0,
-            nvme_path=nvme_path,
+            nvme_path=nvme_path, nvme_param_path=nvme_param_path,
             aio_config={"thread_count": aio.thread_count,
                         "block_size": aio.block_size,
                         "queue_depth": aio.queue_depth},

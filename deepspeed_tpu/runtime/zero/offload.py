@@ -62,32 +62,40 @@ def unique_local_shards(arr) -> List[Tuple[Tuple[Tuple[int, int], ...], np.ndarr
     return sorted(out.items())
 
 
+def make_swap_handle(path: str, aio_config: dict, feature: str):
+    """Shared NVMe-store setup: availability guard, swap dir, and an O_DIRECT-by-
+    default aio handle (page-cache bypass — these tiers exist because the working
+    set exceeds RAM; per-filesystem buffered fallback inside the handle). One place
+    so the moment and parameter stores cannot diverge on aio-config handling."""
+    import os
+    from ...ops.aio.aio_handle import AsyncIOHandle, aio_available
+    if not aio_available():
+        raise RuntimeError(f"{feature} requires the native aio op (C++ toolchain)")
+    os.makedirs(path, exist_ok=True)
+    return AsyncIOHandle(
+        thread_count=aio_config.get("thread_count", 1),
+        block_size=aio_config.get("block_size", 1 << 20),
+        queue_depth=aio_config.get("queue_depth", 8),
+        o_direct=aio_config.get("use_o_direct", True))
+
+
 class _NVMeMomentStore:
     """Adam moments on disk, double-buffered through the native aio handle.
 
     Layout: one file per leaf under ``path`` holding m then v back-to-back (fp32).
     ``adam_step_all`` pipelines: while leaf ``i`` runs the SIMD Adam on scratch buffer
-    ``i % 2``, leaf ``i+1``'s moments stream into buffer ``(i+1) % 2``.
+    ``i % 2``, leaf ``i+1``'s moments stream into buffer ``(i + 1) % 2``.
     """
 
     def __init__(self, path: str, masters, aio_config: dict):
         import os
-        from ...ops.aio.aio_handle import (AsyncIOHandle, aio_available,
-                                           aligned_array, padded_len)
-        if not aio_available():
-            raise RuntimeError("offload_optimizer.device=nvme requires the native "
-                               "aio op (C++ toolchain)")
-        os.makedirs(path, exist_ok=True)
+        from ...ops.aio.aio_handle import aligned_array, padded_len
         self.path = path
-        # O_DIRECT by default (page-cache bypass — the tier exists because the
-        # working set exceeds RAM); per-filesystem buffered fallback inside the handle
-        self.handle = AsyncIOHandle(
-            thread_count=aio_config.get("thread_count", 1),
-            block_size=aio_config.get("block_size", 1 << 20),
-            queue_depth=aio_config.get("queue_depth", 8),
-            o_direct=aio_config.get("use_o_direct", True))
+        self.handle = make_swap_handle(path, aio_config,
+                                       "offload_optimizer.device=nvme")
         self._padded_len = padded_len
-        self.sizes = [int(m.size) for m in masters]
+        # masters: numpy leaves or plain element counts
+        self.sizes = [int(getattr(m, "size", m)) for m in masters]
         self._files = [os.path.join(path, f"moments_leaf{i}.bin")
                        for i in range(len(masters))]
         max_size = max(self.sizes)
@@ -129,6 +137,28 @@ class _NVMeMomentStore:
             self.handle.async_pwrite(mv[:self._io_len(i)], self._files[i])
             self._dirty[i] = True
             self.handle.wait()
+
+    # ---------------------------------------------------------- per-leaf streaming
+    # (the combined masters+grads+moments update loop of the NVMe param tier
+    # interleaves leaves across stores, so it drives this store leaf-by-leaf)
+    def fetch_slot(self, i: int, slot: int):
+        """Async-read leaf ``i``'s moments into double-buffer ``slot``."""
+        self._fetch(i, self._scratch[slot])
+
+    def slot_views(self, i: int, slot: int):
+        """(m, v) fp32 views of leaf ``i`` inside double-buffer ``slot``."""
+        s = self.sizes[i]
+        mv = self._scratch[slot]
+        return mv[:s], mv[s:2 * s]
+
+    def write_slot(self, i: int, slot: int):
+        """Async-write leaf ``i``'s moments back from double-buffer ``slot``."""
+        self.handle.async_pwrite(self._scratch[slot][:self._io_len(i)],
+                                 self._files[i])
+        self._dirty[i] = True
+
+    def wait(self):
+        self.handle.wait()
 
     # ------------------------------------------------------------------ streaming ckpt
     def copy_files_to(self, dest_dir: str):

@@ -18,7 +18,11 @@ model's :class:`~...models.base.Segment` decomposition:
   fp32 buffers, overlapping the previous segment's backward compute.
 - **update**: the native SIMD Adam (``ops/csrc/adam/cpu_adam.cpp``) updates the masters in
   place; there is no in-HBM optimizer state at all. With ``nvme_path`` the Adam moments
-  live on disk, double-buffered through the async-I/O handle (ZeRO-Infinity).
+  live on disk, double-buffered through the async-I/O handle (ZeRO-Infinity). With
+  ``nvme_param_path`` (``offload_param.device='nvme'``) the fp32 masters AND gradient
+  accumulators live on disk too: host RAM is bounded by the double-buffer scratch
+  (O(largest leaf)), independent of model size — the full "1T parameters on a node"
+  half of ZeRO-Infinity (reference ``swap_tensor/partitioned_param_swapper.py:35``).
 
 Peak HBM ≈ 2 segment param slices + boundary activations + one segment's gradients —
 independent of total model size, which is the reference's "40B on one V100" recipe
@@ -75,6 +79,124 @@ class _StreamCache:
         self._live_bytes.clear()
 
 
+class _NVMeParamTier:
+    """fp32 parameter masters + gradient accumulators on disk — the other half of
+    ZeRO-Infinity (reference ``swap_tensor/partitioned_param_swapper.py:35`` param
+    swapping, ``pipelined_optimizer_swapper.py:55`` read/compute/write overlap).
+
+    Layout: one file per flat leaf (``masters_leaf{i}.bin`` / ``grads_leaf{i}.bin``)
+    under ``path``, O_DIRECT through the native aio handle. Host RAM holds only the
+    double-buffer scratch (4 × padded largest leaf), so with the moment store this
+    tier bounds host memory by O(largest leaf) — independent of model size.
+    """
+
+    def __init__(self, path: str, sizes: List[int], aio_config: dict):
+        import os
+        from ...ops.aio.aio_handle import aligned_array, padded_len
+        from .offload import make_swap_handle
+        self.path = path
+        self.sizes = list(sizes)
+        n = len(self.sizes)
+        self.handle = make_swap_handle(path, aio_config,
+                                       "offload_param.device='nvme'")
+        self._padded = lambda s: padded_len(s, 4)
+        self._mfiles = [os.path.join(path, f"masters_leaf{i}.bin") for i in range(n)]
+        self._gfiles = [os.path.join(path, f"grads_leaf{i}.bin") for i in range(n)]
+        cap = self._padded(max(self.sizes))
+        # 2 master + 2 grad double-buffers + 1 push/cast staging buffer
+        self._mbuf = [aligned_array(cap * 4, np.float32) for _ in range(2)]
+        self._gbuf = [aligned_array(cap * 4, np.float32) for _ in range(2)]
+        self._pushbuf = aligned_array(cap * 4, np.float32)
+        self.scratch_bytes = 5 * cap * 4
+        self.grad_dirty = [False] * n
+        self.leaf_sq = np.zeros(n, np.float64)
+
+    # ------------------------------------------------------------------- masters
+    def write_master(self, i: int, flat: np.ndarray):
+        """Synchronous master write (init / checkpoint restore)."""
+        s = self.sizes[i]
+        buf = self._pushbuf
+        buf[:s] = flat
+        buf[s:self._padded(s)] = 0.0
+        self.handle.sync_pwrite(buf[:self._padded(s)], self._mfiles[i])
+
+    def read_master(self, i: int) -> np.ndarray:
+        """Synchronous master read into the staging buffer (valid until the next
+        push/read on this tier)."""
+        s = self.sizes[i]
+        self.handle.sync_pread(self._pushbuf[:self._padded(s)], self._mfiles[i])
+        return self._pushbuf[:s]
+
+    def read_masters_pipelined(self, indices):
+        """Yield each leaf's flat fp32 master with one-leaf read-ahead: leaf j+1
+        streams from disk while the consumer casts/pushes leaf j (the segment-push
+        analogue of the update loop's ``fetch_mg`` double-buffering). Each yielded
+        view is valid only until the next iteration — consumers must copy (cast)
+        before advancing."""
+        idx = list(indices)
+        if not idx:
+            return
+        self.handle.async_pread(
+            self._mbuf[0][:self._padded(self.sizes[idx[0]])], self._mfiles[idx[0]])
+        self.handle.wait()
+        for j, i in enumerate(idx):
+            if j + 1 < len(idx):
+                nxt = idx[j + 1]
+                self.handle.async_pread(
+                    self._mbuf[(j + 1) % 2][:self._padded(self.sizes[nxt])],
+                    self._mfiles[nxt])
+            yield self._mbuf[j % 2][:self.sizes[i]]
+            self.handle.wait()
+
+    # ------------------------------------------------------------------- grads
+    def reset_grads(self):
+        self.grad_dirty = [False] * len(self.sizes)
+        self.leaf_sq[:] = 0.0
+
+    def accumulate_leaf(self, i: int, contrib: np.ndarray):
+        """accum[i] += contrib (read-modify-write through scratch); tracks the
+        leaf's current sum-of-squares so the update pass needs no extra norm pass."""
+        s = self.sizes[i]
+        buf = self._gbuf[0]
+        if self.grad_dirty[i]:
+            self.handle.sync_pread(buf[:self._padded(s)], self._gfiles[i])
+            acc = buf[:s]
+            acc += contrib
+        else:
+            buf[:s] = contrib
+            buf[s:self._padded(s)] = 0.0
+            acc = buf[:s]
+        self.leaf_sq[i] = np.dot(acc, acc)
+        self.handle.sync_pwrite(buf[:self._padded(s)], self._gfiles[i])
+        self.grad_dirty[i] = True
+
+    # -------------------------------------------------------------------- update
+    def fetch_mg(self, i: int, slot: int):
+        """Async reads of leaf ``i``'s masters+grads into double-buffer ``slot``."""
+        p = self._padded(self.sizes[i])
+        self.handle.async_pread(self._mbuf[slot][:p], self._mfiles[i])
+        self.handle.async_pread(self._gbuf[slot][:p], self._gfiles[i])
+
+    def write_master_async(self, i: int, slot: int):
+        self.handle.async_pwrite(
+            self._mbuf[slot][:self._padded(self.sizes[i])], self._mfiles[i])
+
+    # ---------------------------------------------------------------- streaming ckpt
+    def copy_masters_to(self, dest_dir: str):
+        import os
+        import shutil
+        os.makedirs(dest_dir, exist_ok=True)
+        self.handle.wait()
+        for f in self._mfiles:
+            shutil.copy2(f, os.path.join(dest_dir, os.path.basename(f)))
+
+    def copy_masters_from(self, src_dir: str):
+        import os
+        import shutil
+        for f in self._mfiles:
+            shutil.copy2(os.path.join(src_dir, os.path.basename(f)), f)
+
+
 class ParamOffloadCoordinator:
     """Host fp32 masters for the WHOLE model + streamed segment execution.
 
@@ -92,6 +214,7 @@ class ParamOffloadCoordinator:
                  loss_scaler: Optional[DynamicLossScaler] = None,
                  scaler_state: Optional[LossScaleState] = None,
                  nvme_path: Optional[str] = None,
+                 nvme_param_path: Optional[str] = None,
                  aio_config: Optional[dict] = None,
                  mesh=None):
         assert segments and segments[0].kind == "first" \
@@ -109,12 +232,54 @@ class ParamOffloadCoordinator:
         self._fwd_fns: Dict[int, Any] = {}
         self._bwd_fns: Dict[int, Any] = {}
         self._loss_fns: Dict[int, Any] = {}
+        self.nvme_params = nvme_param_path is not None
+        if self.nvme_params:
+            if kind not in ("adam", "adamw"):
+                raise ValueError("offload_param.device='nvme' supports adam/adamw "
+                                 f"only (got {kind!r})")
+            if nvme_path is None:
+                # masters on disk imply the moment store on disk: if 4N of params
+                # don't fit in host RAM, 8N of Adam moments certainly don't
+                import os
+                nvme_path = os.path.join(nvme_param_path, "moments")
 
-        # ---- host masters, one entry per top-level key (init per segment, so no
-        # full-model device materialisation ever happens) -------------------------
+        # ---- metadata pass (no compute): shapes / treedefs / leaf order ---------
         self.key_treedef: Dict[str, Any] = {}
         self.key_shapes: Dict[str, List[tuple]] = {}
-        self.masters: Dict[str, List[np.ndarray]] = {}
+        self._key_order: List[str] = []
+        for si, seg in enumerate(segments):
+            if not seg.init_keys:
+                continue
+            seg_rng = jax.random.fold_in(rng, si)
+            abstract = jax.eval_shape(seg.init_fn, seg_rng)
+            assert len(abstract) == len(seg.init_keys), \
+                f"segment {seg.name}: init_fn must return one subtree per init_key"
+            for key, subtree in zip(seg.init_keys, abstract):
+                assert key not in self.key_treedef, \
+                    f"segment {seg.name}: key {key!r} initialised twice"
+                leaves, treedef = jax.tree_util.tree_flatten(subtree)
+                self.key_treedef[key] = treedef
+                self.key_shapes[key] = [tuple(l.shape) for l in leaves]
+                self._key_order.append(key)
+        # global flat leaf order (checkpoints, optimizer state, NVMe files)
+        self._leaf_index: Dict[str, List[int]] = {}
+        sizes: List[int] = []
+        for k in self._key_order:
+            idx = []
+            for shape in self.key_shapes[k]:
+                idx.append(len(sizes))
+                sizes.append(int(np.prod(shape)))
+            self._leaf_index[k] = idx
+        self.leaf_sizes = sizes
+        self.total_params = int(sum(sizes))
+
+        self.param_tier = (_NVMeParamTier(nvme_param_path, sizes, aio_config or {})
+                           if self.nvme_params else None)
+
+        # ---- init pass: one segment at a time (no full-model device or host
+        # materialisation — NVMe mode writes each key to disk and frees it) -------
+        self.masters: Optional[Dict[str, List[np.ndarray]]] = \
+            None if self.nvme_params else {}
         init_jits: Dict[Any, Any] = {}   # one jit per shared init_fn object
         for si, seg in enumerate(segments):
             if not seg.init_keys:
@@ -123,55 +288,53 @@ class ParamOffloadCoordinator:
             if seg.init_fn not in init_jits:
                 init_jits[seg.init_fn] = jax.jit(seg.init_fn)
             dev = init_jits[seg.init_fn](seg_rng)   # device, segment-sized tuple
-            assert len(dev) == len(seg.init_keys), \
-                f"segment {seg.name}: init_fn must return one subtree per init_key"
             for key, subtree in zip(seg.init_keys, dev):
-                assert key not in self.masters, \
-                    f"segment {seg.name}: key {key!r} initialised twice"
-                leaves, treedef = jax.tree_util.tree_flatten(subtree)
+                leaves = jax.tree_util.tree_leaves(subtree)
                 for l in leaves:
                     l.copy_to_host_async()
-                self.key_treedef[key] = treedef
-                self.key_shapes[key] = [tuple(l.shape) for l in leaves]
-                self.masters[key] = [
-                    np.array(l, dtype=np.float32, copy=True).reshape(-1)
-                    for l in leaves]
+                if self.nvme_params:
+                    for i, l in zip(self._leaf_index[key], leaves):
+                        self.param_tier.write_master(
+                            i, np.asarray(l, dtype=np.float32).reshape(-1))
+                else:
+                    self.masters[key] = [
+                        np.array(l, dtype=np.float32, copy=True).reshape(-1)
+                        for l in leaves]
             del dev
 
-        # masters in a stable global order (checkpoints, optimizer state)
-        self._key_order = list(self.masters.keys())
-        flat = [m for k in self._key_order for m in self.masters[k]]
-        self.total_params = int(sum(m.size for m in flat))
-        self._accum: Dict[str, List[np.ndarray]] = {
-            k: [np.zeros_like(m) for m in self.masters[k]] for k in self._key_order}
+        self._accum: Optional[Dict[str, List[np.ndarray]]] = None
+        if not self.nvme_params:
+            self._accum = {k: [np.zeros_like(m) for m in self.masters[k]]
+                           for k in self._key_order}
 
         self.nvme = None
         if kind in ("adam", "adamw"):
             if nvme_path is not None:
                 from .offload import _NVMeMomentStore
-                self.nvme = _NVMeMomentStore(nvme_path, flat, aio_config or {})
+                self.nvme = _NVMeMomentStore(nvme_path, sizes, aio_config or {})
                 self._adam_kwargs = dict(betas=betas, eps=eps,
                                          weight_decay=weight_decay,
                                          adam_w_mode=adam_w_mode,
                                          bias_correction=bias_correction)
                 self.step_count = 0
             else:
-                self.opt = DeepSpeedCPUAdam(flat, betas=betas, eps=eps,
-                                            weight_decay=weight_decay,
+                self.opt = DeepSpeedCPUAdam(self._flat_masters(), betas=betas,
+                                            eps=eps, weight_decay=weight_decay,
                                             adamw_mode=adam_w_mode,
                                             bias_correction=bias_correction)
                 # masters already flat fp32 → shared views, updates land in self.masters
                 self._rebind_masters(self.opt.params)
         elif kind == "adagrad":
             self.eps, self.weight_decay = eps, weight_decay
-            self.sq_sum = [np.zeros_like(m) for m in flat]
+            self.sq_sum = [np.zeros(s, np.float32) for s in sizes]
             self.step_count = 0
         else:
             raise ValueError(f"offload_param optimizer kind {kind!r} "
                              "(adam/adamw/adagrad)")
         self.cache = _StreamCache(self._push_segment)
         log_dist(
-            f"ZeRO-3 param offload: {self.total_params:,} params on host across "
+            f"ZeRO-3 param offload: {self.total_params:,} params on "
+            f"{'NVMe' if self.nvme_params else 'host'} across "
             f"{len(segments)} segments "
             f"({'native SIMD' if native_available() else 'numpy fallback'} {kind}"
             f"{', nvme moments' if self.nvme is not None else ''})", ranks=[0])
@@ -190,6 +353,12 @@ class ParamOffloadCoordinator:
     def _flat_accum(self) -> List[np.ndarray]:
         return [g for k in self._key_order for g in self._accum[k]]
 
+    def _leaf_iter(self):
+        """(global leaf index, key, within-key index) in global flat order."""
+        for k in self._key_order:
+            for li, i in enumerate(self._leaf_index[k]):
+                yield i, k, li
+
     # ------------------------------------------------------------------ device push
     def _replicated_sharding(self):
         if self.mesh is not None:
@@ -200,7 +369,11 @@ class ParamOffloadCoordinator:
         from .offload import cast_master_to
         sh = self._replicated_sharding()
         outs, nbytes = [], 0
-        for m, shape in zip(self.masters[key], self.key_shapes[key]):
+        if self.nvme_params:
+            flats = self.param_tier.read_masters_pipelined(self._leaf_index[key])
+        else:
+            flats = self.masters[key]
+        for m, shape in zip(flats, self.key_shapes[key]):
             host = cast_master_to(m, shape, self.compute_dtype)
             nbytes += host.nbytes
             outs.append(jax.device_put(host, sh) if sh is not None
@@ -274,20 +447,29 @@ class ParamOffloadCoordinator:
 
     # ------------------------------------------------------------------ accumulation
     def _zero_accum(self):
+        if self.nvme_params:
+            self.param_tier.reset_grads()
+            return
         for k in self._key_order:
             for g in self._accum[k]:
                 g.fill(0.0)
 
     def _accumulate(self, si: int, gp):
         """Fold one segment's device param-grads (tuple, param_keys order) into the host
-        fp32 accumulators. The caller dispatches the NEXT segment's backward before
-        invoking this, so the blocking D2H read below overlaps that segment's compute."""
+        fp32 accumulators (NVMe mode: read-modify-write of the on-disk accumulator
+        files). The caller dispatches the NEXT segment's backward before invoking
+        this, so the blocking D2H read below overlaps that segment's compute."""
         for key, sub in zip(self.segments[si].param_keys, gp):
             leaves = jax.tree_util.tree_leaves(sub)
             for l in leaves:
                 l.copy_to_host_async()
-            for acc, l in zip(self._accum[key], leaves):
-                acc += np.asarray(l, dtype=np.float32).reshape(-1)
+            if self.nvme_params:
+                for i, l in zip(self._leaf_index[key], leaves):
+                    self.param_tier.accumulate_leaf(
+                        i, np.asarray(l, dtype=np.float32).reshape(-1))
+            else:
+                for acc, l in zip(self._accum[key], leaves):
+                    acc += np.asarray(l, dtype=np.float32).reshape(-1)
 
     # ------------------------------------------------------------------ step
     def _cur_scale(self) -> float:
@@ -362,18 +544,40 @@ class ParamOffloadCoordinator:
         metrics["loss"] = float(np.mean([float(l) for l in losses]))
         return metrics
 
+    # shared overflow/clip/scaler scaffolding — ONE definition so the RAM and NVMe
+    # update paths cannot silently diverge (test_matches_ram_mode pins them equal)
+    def _norm_overflow(self, total_sq: float):
+        norm = float(np.sqrt(total_sq))
+        return norm, self.fp16_enabled and not np.isfinite(norm)
+
+    def _clip_coef(self, norm: float) -> float:
+        clip = self.gradient_clipping
+        if clip and clip > 0 and np.isfinite(norm) and norm > clip:
+            return clip / (norm + 1e-6)
+        return 1.0
+
+    def _finish_update(self, overflow: bool, norm: float, scale: float
+                       ) -> Dict[str, Any]:
+        if overflow:
+            self._skipped_steps += 1
+        if self.loss_scaler is not None and self.scaler_state is not None:
+            self.scaler_state = self.loss_scaler.update(
+                self.scaler_state, jnp.asarray(overflow))
+        return {"grad_norm": norm, "overflow": overflow, "loss_scale": scale}
+
     def _host_update(self, lr: float, n_micro: int, scale: float) -> Dict[str, Any]:
+        if self.nvme_params:
+            return self._nvme_params_update(lr, n_micro, scale)
         inv = np.float32(1.0 / (scale * n_micro))
         total_sq = 0.0
         flat_grads = self._flat_accum()
         for g in flat_grads:
             g *= inv
             total_sq += float(np.dot(g, g))
-        norm = float(np.sqrt(total_sq))
-        overflow = self.fp16_enabled and not np.isfinite(norm)
-        clip = self.gradient_clipping
-        if clip and clip > 0 and np.isfinite(norm) and norm > clip:
-            coef = np.float32(clip / (norm + 1e-6))
+        norm, overflow = self._norm_overflow(total_sq)
+        coef = self._clip_coef(norm)
+        if coef != 1.0:
+            coef = np.float32(coef)
             for g in flat_grads:
                 g *= coef
         if not overflow:
@@ -388,12 +592,46 @@ class ParamOffloadCoordinator:
                 self.step_count += 1
                 for p, s, g in zip(masters, self.sq_sum, flat_grads):
                     adagrad_step(p, s, g, lr, self.eps, self.weight_decay)
-        else:
-            self._skipped_steps += 1
-        if self.loss_scaler is not None and self.scaler_state is not None:
-            self.scaler_state = self.loss_scaler.update(
-                self.scaler_state, jnp.asarray(overflow))
-        return {"grad_norm": norm, "overflow": overflow, "loss_scale": scale}
+        return self._finish_update(overflow, norm, scale)
+
+    def _nvme_params_update(self, lr: float, n_micro: int, scale: float
+                            ) -> Dict[str, Any]:
+        """Streamed masters+grads+moments update: while leaf ``i`` runs the SIMD
+        Adam, leaf ``i+1``'s three tensors stream in from disk and leaf ``i-1``'s
+        masters/moments stream back out (reference
+        ``pipelined_optimizer_swapper.py:55`` read/compute/write overlap). The
+        global grad norm comes free from the per-leaf sums-of-squares tracked at
+        accumulation time — no extra pass over the grad files."""
+        from ...ops.adam.cpu_adam import adam_step
+        tier, mom = self.param_tier, self.nvme
+        inv = 1.0 / (scale * n_micro)
+        norm, overflow = self._norm_overflow(float(tier.leaf_sq.sum()) * inv * inv)
+        coef = np.float32(inv * self._clip_coef(norm))
+        if not overflow:
+            self.step_count += 1
+            kw = self._adam_kwargs
+            n = len(self.leaf_sizes)
+            tier.fetch_mg(0, 0)
+            mom.fetch_slot(0, 0)
+            tier.handle.wait()
+            mom.wait()
+            for i in range(n):
+                if i + 1 < n:  # overlap: next leaf streams in during this compute
+                    tier.fetch_mg(i + 1, (i + 1) % 2)
+                    mom.fetch_slot(i + 1, (i + 1) % 2)
+                s = self.leaf_sizes[i]
+                g = tier._gbuf[i % 2][:s]
+                g *= coef
+                m_mom, v_mom = mom.slot_views(i, i % 2)
+                adam_step(tier._mbuf[i % 2][:s], m_mom, v_mom, g, lr,
+                          kw["betas"][0], kw["betas"][1], kw["eps"],
+                          kw["weight_decay"], kw["adam_w_mode"], self.step_count,
+                          kw["bias_correction"])
+                tier.write_master_async(i, i % 2)
+                mom.write_slot(i, i % 2)
+                tier.handle.wait()
+                mom.wait()
+        return self._finish_update(overflow, norm, scale)
 
     # ------------------------------------------------------------------ eval
     def eval_loss(self, batch, rng) -> Any:
@@ -417,12 +655,18 @@ class ParamOffloadCoordinator:
         return x
 
     # ------------------------------------------------------------------ test hooks
+    def _master_flat(self, key: str, li: int) -> np.ndarray:
+        """Leaf ``li`` of ``key``'s fp32 master, flat (copied out of NVMe scratch)."""
+        if self.nvme_params:
+            return self.param_tier.read_master(self._leaf_index[key][li]).copy()
+        return self.masters[key][li]
+
     def full_params_host(self) -> Dict[str, Any]:
         """Assemble the full fp32 parameter tree on host (tests / export only)."""
         return {k: jax.tree_util.tree_unflatten(
                     self.key_treedef[k],
-                    [m.reshape(s) for m, s in
-                     zip(self.masters[k], self.key_shapes[k])])
+                    [self._master_flat(k, li).reshape(s)
+                     for li, s in enumerate(self.key_shapes[k])])
                 for k in self.key_treedef}
 
     def load_full_params(self, tree: Dict[str, Any]):
@@ -430,9 +674,14 @@ class ParamOffloadCoordinator:
         ``full_params_host``); optimizer moments are left untouched."""
         for k in self._key_order:
             leaves = jax.tree_util.tree_leaves(tree[k])
-            assert len(leaves) == len(self.masters[k]), f"leaf mismatch for {k!r}"
-            for dst, src in zip(self.masters[k], leaves):
-                np.copyto(dst, np.asarray(src, dtype=np.float32).reshape(-1))
+            assert len(leaves) == len(self.key_shapes[k]), f"leaf mismatch for {k!r}"
+            if self.nvme_params:
+                for i, src in zip(self._leaf_index[k], leaves):
+                    self.param_tier.write_master(
+                        i, np.asarray(src, dtype=np.float32).reshape(-1))
+            else:
+                for dst, src in zip(self.masters[k], leaves):
+                    np.copyto(dst, np.asarray(src, dtype=np.float32).reshape(-1))
 
     @property
     def skipped_steps(self) -> int:
@@ -442,11 +691,14 @@ class ParamOffloadCoordinator:
     def _light_state_dict(self) -> Dict[str, Any]:
         """Masters + step + scaler — everything EXCEPT the Adam moments. The NVMe
         checkpoint path uses this so the on-disk moment store is never materialised in
-        host RAM (the tier exists because 2× fp32 moments don't fit there)."""
+        host RAM (the tier exists because 2× fp32 moments don't fit there). With
+        masters themselves on NVMe they are excluded too (streamed by file copy)."""
         sd: Dict[str, Any] = {"step": np.int64(getattr(self, "step_count", 0))}
-        for k in self._key_order:
-            for li, (m, s) in enumerate(zip(self.masters[k], self.key_shapes[k])):
-                sd[f"master/{k}/{li}"] = m.reshape(s)
+        if not self.nvme_params:
+            for k in self._key_order:
+                for li, (m, s) in enumerate(zip(self.masters[k],
+                                                self.key_shapes[k])):
+                    sd[f"master/{k}/{li}"] = m.reshape(s)
         if self.scaler_state is not None:
             sd["scaler"] = np.asarray(
                 [float(self.scaler_state.cur_scale),
@@ -459,6 +711,10 @@ class ParamOffloadCoordinator:
         """Full state incl. moments in host RAM — RAM-mode checkpoints and tests.
         NVMe mode materialises the moment store; use save_to for streaming."""
         sd = self._light_state_dict()
+        if self.nvme_params:
+            for k in self._key_order:
+                for li, s in enumerate(self.key_shapes[k]):
+                    sd[f"master/{k}/{li}"] = self._master_flat(k, li).reshape(s)
         if self.nvme is not None:
             ms, vs = self.nvme.read_moments()
             for i, (m, v) in enumerate(zip(ms, vs)):
@@ -475,9 +731,13 @@ class ParamOffloadCoordinator:
 
     def _restore_masters(self, sd: dict):
         for k in self._key_order:
-            for li, m in enumerate(self.masters[k]):
-                np.copyto(m, np.asarray(sd[f"master/{k}/{li}"],
-                                        dtype=np.float32).reshape(-1))
+            for li in range(len(self.key_shapes[k])):
+                flat = np.asarray(sd[f"master/{k}/{li}"],
+                                  dtype=np.float32).reshape(-1)
+                if self.nvme_params:
+                    self.param_tier.write_master(self._leaf_index[k][li], flat)
+                else:
+                    np.copyto(self.masters[k][li], flat)
 
     def _restore_scaler(self, sd: dict):
         if "scaler" in sd and self.scaler_state is not None:
@@ -488,7 +748,7 @@ class ParamOffloadCoordinator:
 
     def load_state_dict(self, sd: dict):
         self._restore_masters(sd)
-        n = len(self._flat_masters())
+        n = len(self.leaf_sizes)
         if self.nvme is not None:
             self.step_count = int(sd["step"])
             self.nvme.write_moments([np.asarray(sd[f"m/{i}"]) for i in range(n)],
@@ -507,10 +767,12 @@ class ParamOffloadCoordinator:
 
     def save_to(self, checkpoint_engine, path: str):
         if self.nvme is not None:
-            # moments are already serialized on disk — stream by file copy, never
-            # through host RAM
+            # on-disk state (moments; with nvme_params also masters) is already
+            # serialized — stream by file copy, never through host RAM
             checkpoint_engine.save(self._light_state_dict(), path)
             self.nvme.copy_files_to(path + "_moments")
+            if self.nvme_params:
+                self.param_tier.copy_masters_to(path + "_masters")
             return
         checkpoint_engine.save(self.state_dict(), path)
 
@@ -521,7 +783,10 @@ class ParamOffloadCoordinator:
         flag for fine-tune-from-pretrain restarts)."""
         if self.nvme is not None:
             sd = checkpoint_engine.load(path, template=self._light_state_dict())
-            self._restore_masters(sd)
+            if self.nvme_params:
+                self.param_tier.copy_masters_from(path + "_masters")
+            else:
+                self._restore_masters(sd)
             if load_optimizer_states:
                 self.step_count = int(sd["step"])
                 self.nvme.copy_files_from(path + "_moments")
