@@ -114,10 +114,8 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "offload_param requires a segmented model (Model.segments — see "
                     "models.causal_lm.causal_lm_segments); this model has none")
-            if dist.get_world_size() > 1:
-                raise NotImplementedError(
-                    "offload_param is single-controller (any chips-per-host): on "
-                    "multi-host pods shard the model over the fsdp axis instead")
+            # multi-process runs partition masters per process along the gradient
+            # layout (ParamOffloadCoordinator._partitioned) — no world-size gate
             self.offload_enabled = False  # coordinator owns the optimizer tier
         if self._config.sparse_gradients_enabled:
             logger.warning(

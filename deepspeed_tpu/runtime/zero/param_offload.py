@@ -233,15 +233,30 @@ class ParamOffloadCoordinator:
         self._bwd_fns: Dict[int, Any] = {}
         self._loss_fns: Dict[int, Any] = {}
         self.nvme_params = nvme_param_path is not None
+        # multi-process: per-process partitioned masters along the gradient layout
+        # (the r3 optimizer-tier recipe — reference per-rank cpu offload,
+        # stage_1_and_2.py:130); each process owns only its devices' unique shards
+        self._partitioned = jax.process_count() > 1
+        if self._partitioned and mesh is None:
+            raise ValueError("multi-process offload_param needs a device mesh")
+        import os
         if self.nvme_params:
             if kind not in ("adam", "adamw"):
                 raise ValueError("offload_param.device='nvme' supports adam/adamw "
                                  f"only (got {kind!r})")
+            if self._partitioned:
+                # per-process partition files; nvme_param_path may be shared storage
+                nvme_param_path = os.path.join(nvme_param_path,
+                                               f"proc{jax.process_index()}")
             if nvme_path is None:
                 # masters on disk imply the moment store on disk: if 4N of params
                 # don't fit in host RAM, 8N of Adam moments certainly don't
-                import os
                 nvme_path = os.path.join(nvme_param_path, "moments")
+        if nvme_path is not None and self._partitioned \
+                and not nvme_path.endswith(f"proc{jax.process_index()}"):
+            # per-process moment files regardless of which knob enabled the store
+            # (slot sizes differ per process; a shared dir would cross-clobber)
+            nvme_path = os.path.join(nvme_path, f"proc{jax.process_index()}")
 
         # ---- metadata pass (no compute): shapes / treedefs / leaf order ---------
         self.key_treedef: Dict[str, Any] = {}
@@ -273,26 +288,110 @@ class ParamOffloadCoordinator:
         self.leaf_sizes = sizes
         self.total_params = int(sum(sizes))
 
-        self.param_tier = (_NVMeParamTier(nvme_param_path, sizes, aio_config or {})
+        # ---- partitioned-mode slot bookkeeping ----------------------------------
+        # One SLOT = one unique addressable shard of one leaf in the GRADIENT
+        # layout (dim-0 sharded over the dp axes when divisible, else replicated).
+        # Masters/accumulators/NVMe files index by slot; replicated leaves are
+        # updated identically on every process but counted toward the grad norm by
+        # their lowest-device owner only.
+        if self._partitioned:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ...parallel.mesh import AXIS_DATA, AXIS_FSDP
+            dp_axes = tuple(ax for ax in (AXIS_DATA, AXIS_FSDP)
+                            if self.mesh.size(ax) > 1)
+            dp_total = int(np.prod([self.mesh.size(ax) for ax in dp_axes])) \
+                if dp_axes else 1
+
+            def gspec(shape):
+                if dp_axes and shape and shape[0] % dp_total == 0:
+                    return P(dp_axes, *([None] * (len(shape) - 1)))
+                return P(*([None] * len(shape)))
+
+            my_proc = jax.process_index()
+            self._gshard: Dict[str, List[Any]] = {}
+            self._slot_meta: List[tuple] = []   # (key, li, norm_key, shape, owned)
+            self._slots_by_leaf: Dict[tuple, List[int]] = {}
+            slot_sizes: List[int] = []
+            for k in self._key_order:
+                shards = []
+                for li, shape in enumerate(self.key_shapes[k]):
+                    sh = NamedSharding(self.mesh.mesh, gspec(shape))
+                    shards.append(sh)
+                    # ownership: the process of the lowest-id device holding each
+                    # distinct shard (deterministic, no communication)
+                    owner: Dict[tuple, Any] = {}
+                    local: Dict[tuple, tuple] = {}
+                    from .offload import _norm_index
+                    for dev, index in sh.devices_indices_map(shape).items():
+                        nk = _norm_index(index, shape)
+                        if nk not in owner or dev.id < owner[nk].id:
+                            owner[nk] = dev
+                        if dev.process_index == my_proc:
+                            local[nk] = tuple(b - a for a, b in nk)
+                    ids = []
+                    for nk in sorted(local):
+                        ids.append(len(self._slot_meta))
+                        slot_sizes.append(int(np.prod(local[nk])) if local[nk]
+                                          else 1)
+                        self._slot_meta.append(
+                            (k, li, nk, local[nk],
+                             owner[nk].process_index == my_proc))
+                    self._slots_by_leaf[(k, li)] = ids
+                self._gshard[k] = shards
+            self._flat_sizes = slot_sizes
+        else:
+            self._flat_sizes = sizes
+
+        self.param_tier = (_NVMeParamTier(nvme_param_path, self._flat_sizes,
+                                          aio_config or {})
                            if self.nvme_params else None)
 
         # ---- init pass: one segment at a time (no full-model device or host
-        # materialisation — NVMe mode writes each key to disk and frees it) -------
+        # materialisation — NVMe mode writes each key to disk and frees it;
+        # partitioned mode inits straight into the grad layout and keeps only this
+        # process's unique shards) ------------------------------------------------
         self.masters: Optional[Dict[str, List[np.ndarray]]] = \
-            None if self.nvme_params else {}
+            None if (self.nvme_params or self._partitioned) else {}
+        self._masters_p: Optional[List[np.ndarray]] = \
+            [None] * len(self._flat_sizes) \
+            if (self._partitioned and not self.nvme_params) else None
         init_jits: Dict[Any, Any] = {}   # one jit per shared init_fn object
         for si, seg in enumerate(segments):
             if not seg.init_keys:
                 continue
             seg_rng = jax.random.fold_in(rng, si)
             if seg.init_fn not in init_jits:
-                init_jits[seg.init_fn] = jax.jit(seg.init_fn)
+                if self._partitioned:
+                    out_sh = tuple(
+                        jax.tree_util.tree_unflatten(self.key_treedef[k],
+                                                     self._gshard[k])
+                        for k in seg.init_keys)
+                    init_jits[seg.init_fn] = jax.jit(
+                        lambda r, fn=seg.init_fn: jax.tree_util.tree_map(
+                            lambda x: x.astype(jnp.float32), fn(r)),
+                        out_shardings=out_sh)
+                else:
+                    init_jits[seg.init_fn] = jax.jit(seg.init_fn)
             dev = init_jits[seg.init_fn](seg_rng)   # device, segment-sized tuple
             for key, subtree in zip(seg.init_keys, dev):
                 leaves = jax.tree_util.tree_leaves(subtree)
                 for l in leaves:
                     l.copy_to_host_async()
-                if self.nvme_params:
+                if self._partitioned:
+                    from .offload import unique_local_shards
+                    for li, l in enumerate(leaves):
+                        pairs = unique_local_shards(l)
+                        ids = self._slots_by_leaf[(key, li)]
+                        assert [p[0] for p in pairs] == \
+                            [self._slot_meta[s][2] for s in ids]
+                        for sid, (_, data) in zip(ids, pairs):
+                            flat = np.array(data, dtype=np.float32,
+                                            copy=True).reshape(-1)
+                            if self.nvme_params:
+                                self.param_tier.write_master(sid, flat)
+                            else:
+                                self._masters_p[sid] = flat
+                elif self.nvme_params:
                     for i, l in zip(self._leaf_index[key], leaves):
                         self.param_tier.write_master(
                             i, np.asarray(l, dtype=np.float32).reshape(-1))
@@ -303,7 +402,10 @@ class ParamOffloadCoordinator:
             del dev
 
         self._accum: Optional[Dict[str, List[np.ndarray]]] = None
-        if not self.nvme_params:
+        self._accum_p: Optional[List[np.ndarray]] = None
+        if self._partitioned and not self.nvme_params:
+            self._accum_p = [np.zeros(s, np.float32) for s in self._flat_sizes]
+        elif not self.nvme_params:
             self._accum = {k: [np.zeros_like(m) for m in self.masters[k]]
                            for k in self._key_order}
 
@@ -311,7 +413,8 @@ class ParamOffloadCoordinator:
         if kind in ("adam", "adamw"):
             if nvme_path is not None:
                 from .offload import _NVMeMomentStore
-                self.nvme = _NVMeMomentStore(nvme_path, sizes, aio_config or {})
+                self.nvme = _NVMeMomentStore(nvme_path, self._flat_sizes,
+                                             aio_config or {})
                 self._adam_kwargs = dict(betas=betas, eps=eps,
                                          weight_decay=weight_decay,
                                          adam_w_mode=adam_w_mode,
@@ -326,7 +429,7 @@ class ParamOffloadCoordinator:
                 self._rebind_masters(self.opt.params)
         elif kind == "adagrad":
             self.eps, self.weight_decay = eps, weight_decay
-            self.sq_sum = [np.zeros(s, np.float32) for s in sizes]
+            self.sq_sum = [np.zeros(s, np.float32) for s in self._flat_sizes]
             self.step_count = 0
         else:
             raise ValueError(f"offload_param optimizer kind {kind!r} "
@@ -340,7 +443,10 @@ class ParamOffloadCoordinator:
             f"{', nvme moments' if self.nvme is not None else ''})", ranks=[0])
 
     def _rebind_masters(self, flat: List[np.ndarray]):
-        """Re-point self.masters at (possibly re-allocated) flat buffers."""
+        """Re-point the masters at (possibly re-allocated) flat buffers."""
+        if self._partitioned:
+            self._masters_p = list(flat)
+            return
         i = 0
         for k in self._key_order:
             n = len(self.masters[k])
@@ -348,16 +454,14 @@ class ParamOffloadCoordinator:
             i += n
 
     def _flat_masters(self) -> List[np.ndarray]:
+        if self._partitioned:
+            return self._masters_p
         return [m for k in self._key_order for m in self.masters[k]]
 
     def _flat_accum(self) -> List[np.ndarray]:
+        if self._partitioned:
+            return self._accum_p
         return [g for k in self._key_order for g in self._accum[k]]
-
-    def _leaf_iter(self):
-        """(global leaf index, key, within-key index) in global flat order."""
-        for k in self._key_order:
-            for li, i in enumerate(self._leaf_index[k]):
-                yield i, k, li
 
     # ------------------------------------------------------------------ device push
     def _replicated_sharding(self):
@@ -366,6 +470,8 @@ class ParamOffloadCoordinator:
         return None
 
     def _push_key(self, key: str):
+        if self._partitioned:
+            return self._push_key_partitioned(key)
         from .offload import cast_master_to
         sh = self._replicated_sharding()
         outs, nbytes = [], 0
@@ -379,6 +485,44 @@ class ParamOffloadCoordinator:
             outs.append(jax.device_put(host, sh) if sh is not None
                         else jax.device_put(host))
         return jax.tree_util.tree_unflatten(self.key_treedef[key], outs), nbytes
+
+    def _push_key_partitioned(self, key: str):
+        """Per-process master slices → grad-layout global arrays → one jitted
+        reshard to replicated (XLA all-gathers over ICI — the optimizer tier's
+        ``_push_partitioned`` applied per streamed key)."""
+        from .offload import _norm_index, cast_master_to
+        outs, nbytes = [], 0
+        slot_ids = [sid for li in range(len(self.key_shapes[key]))
+                    for sid in self._slots_by_leaf[(key, li)]]
+        if self.nvme_params:
+            slot_data = dict(zip(slot_ids, (
+                f.copy() for f in
+                self.param_tier.read_masters_pipelined(slot_ids))))
+        else:
+            slot_data = {sid: self._masters_p[sid] for sid in slot_ids}
+        for li, shape in enumerate(self.key_shapes[key]):
+            gsh = self._gshard[key][li]
+            by_idx = {self._slot_meta[sid][2]: sid
+                      for sid in self._slots_by_leaf[(key, li)]}
+            singles = []
+            for dev, index in gsh.addressable_devices_indices_map(shape).items():
+                nk = _norm_index(index, shape)
+                sid = by_idx[nk]
+                host = cast_master_to(slot_data[sid], self._slot_meta[sid][3],
+                                      self.compute_dtype)
+                nbytes += host.nbytes
+                singles.append(jax.device_put(host, dev))
+            outs.append(jax.make_array_from_single_device_arrays(
+                shape, gsh, singles))
+        tree = jax.tree_util.tree_unflatten(self.key_treedef[key], outs)
+        if not hasattr(self, "_reshard_fns"):
+            self._reshard_fns = {}
+        if key not in self._reshard_fns:
+            repl = self._replicated_sharding()
+            self._reshard_fns[key] = jax.jit(
+                lambda t: t, out_shardings=jax.tree_util.tree_map(
+                    lambda _: repl, tree))
+        return self._reshard_fns[key](tree), nbytes
 
     def _push_segment(self, si: int):
         """Ordered tuple of subtrees (param_keys order) — uniform pytree structure
@@ -402,7 +546,8 @@ class ParamOffloadCoordinator:
 
     def _bwd(self, si: int):
         """Per-segment VJP. Recomputes the segment forward inside (remat at segment
-        granularity); parameter cotangents come back replicated fp32."""
+        granularity); parameter cotangents come back replicated fp32 (partitioned
+        mode: in the grad layout, so each process D2H-reads only its own shards)."""
         seg = self.segments[si]
         key = (seg.kind, seg.apply_fn)
         if key in self._bwd_fns:
@@ -410,6 +555,10 @@ class ParamOffloadCoordinator:
         # param cotangents come back replicated (one addressable full copy for the host
         # read); activation cotangents stay wherever XLA wants them
         repl = self._replicated_sharding()
+        if self._partitioned:
+            repl = tuple(jax.tree_util.tree_unflatten(self.key_treedef[k],
+                                                      self._gshard[k])
+                         for k in seg.param_keys)
         if seg.kind == "first":
             def bwd(p, batch, rng, gout):
                 _, vjp = jax.vjp(lambda pp: seg.apply_fn(pp, batch, rng), p)
@@ -450,6 +599,10 @@ class ParamOffloadCoordinator:
         if self.nvme_params:
             self.param_tier.reset_grads()
             return
+        if self._partitioned:
+            for g in self._accum_p:
+                g.fill(0.0)
+            return
         for k in self._key_order:
             for g in self._accum[k]:
                 g.fill(0.0)
@@ -463,7 +616,21 @@ class ParamOffloadCoordinator:
             leaves = jax.tree_util.tree_leaves(sub)
             for l in leaves:
                 l.copy_to_host_async()
-            if self.nvme_params:
+            if self._partitioned:
+                from .offload import unique_local_shards
+                for li, l in enumerate(leaves):
+                    pairs = unique_local_shards(l)
+                    ids = self._slots_by_leaf[(key, li)]
+                    assert [p[0] for p in pairs] == \
+                        [self._slot_meta[s][2] for s in ids], \
+                        "gradient sharding drifted from the masters partition"
+                    for sid, (_, data) in zip(ids, pairs):
+                        flat = np.asarray(data, dtype=np.float32).reshape(-1)
+                        if self.nvme_params:
+                            self.param_tier.accumulate_leaf(sid, flat)
+                        else:
+                            self._accum_p[sid] += flat
+            elif self.nvme_params:
                 for i, l in zip(self._leaf_index[key], leaves):
                     self.param_tier.accumulate_leaf(
                         i, np.asarray(l, dtype=np.float32).reshape(-1))
@@ -544,6 +711,24 @@ class ParamOffloadCoordinator:
         metrics["loss"] = float(np.mean([float(l) for l in losses]))
         return metrics
 
+    def _owned_flags(self) -> List[bool]:
+        """Which flat slots this process counts toward the GLOBAL grad norm:
+        everything in single-process mode; in partitioned mode only slots whose
+        lowest-id device lives here (replicated slots exist on every process but
+        must be counted once)."""
+        if self._partitioned:
+            return [m[4] for m in self._slot_meta]
+        return [True] * len(self._flat_sizes)
+
+    def _global_sq(self, owned_sq: float) -> float:
+        """Cross-process sum of the owned sum-of-squares (grad-norm all-reduce —
+        reference ``get_global_norm_of_tensors`` across dp ranks)."""
+        if not self._partitioned:
+            return owned_sq
+        from jax.experimental import multihost_utils
+        return float(np.asarray(multihost_utils.process_allgather(
+            np.float64(owned_sq))).sum())
+
     # shared overflow/clip/scaler scaffolding — ONE definition so the RAM and NVMe
     # update paths cannot silently diverge (test_matches_ram_mode pins them equal)
     def _norm_overflow(self, total_sq: float):
@@ -571,10 +756,12 @@ class ParamOffloadCoordinator:
         inv = np.float32(1.0 / (scale * n_micro))
         total_sq = 0.0
         flat_grads = self._flat_accum()
-        for g in flat_grads:
+        owned = self._owned_flags()
+        for j, g in enumerate(flat_grads):
             g *= inv
-            total_sq += float(np.dot(g, g))
-        norm, overflow = self._norm_overflow(total_sq)
+            if owned[j]:
+                total_sq += float(np.dot(g, g))
+        norm, overflow = self._norm_overflow(self._global_sq(total_sq))
         coef = self._clip_coef(norm)
         if coef != 1.0:
             coef = np.float32(coef)
@@ -605,12 +792,14 @@ class ParamOffloadCoordinator:
         from ...ops.adam.cpu_adam import adam_step
         tier, mom = self.param_tier, self.nvme
         inv = 1.0 / (scale * n_micro)
-        norm, overflow = self._norm_overflow(float(tier.leaf_sq.sum()) * inv * inv)
+        owned_sq = float(sum(sq for sq, o in zip(tier.leaf_sq,
+                                                 self._owned_flags()) if o))
+        norm, overflow = self._norm_overflow(self._global_sq(owned_sq) * inv * inv)
         coef = np.float32(inv * self._clip_coef(norm))
         if not overflow:
             self.step_count += 1
             kw = self._adam_kwargs
-            n = len(self.leaf_sizes)
+            n = len(self._flat_sizes)
             tier.fetch_mg(0, 0)
             mom.fetch_slot(0, 0)
             tier.handle.wait()
@@ -619,7 +808,7 @@ class ParamOffloadCoordinator:
                 if i + 1 < n:  # overlap: next leaf streams in during this compute
                     tier.fetch_mg(i + 1, (i + 1) % 2)
                     mom.fetch_slot(i + 1, (i + 1) % 2)
-                s = self.leaf_sizes[i]
+                s = self._flat_sizes[i]
                 g = tier._gbuf[i % 2][:s]
                 g *= coef
                 m_mom, v_mom = mom.slot_views(i, i % 2)
@@ -656,7 +845,21 @@ class ParamOffloadCoordinator:
 
     # ------------------------------------------------------------------ test hooks
     def _master_flat(self, key: str, li: int) -> np.ndarray:
-        """Leaf ``li`` of ``key``'s fp32 master, flat (copied out of NVMe scratch)."""
+        """Leaf ``li`` of ``key``'s fp32 master, flat (copied out of NVMe scratch).
+        Partitioned mode: assembled from this process's slots (replicated-layout
+        leaves only — sharded leaves would need cross-process data; use the pushed
+        device params for those)."""
+        if self._partitioned:
+            ids = self._slots_by_leaf[(key, li)]
+            if len(ids) == 1 and self._slot_meta[ids[0]][3] == \
+                    self.key_shapes[key][li]:
+                sid = ids[0]
+                if self.nvme_params:
+                    return self.param_tier.read_master(sid).copy()
+                return self._masters_p[sid]
+            raise NotImplementedError(
+                "full master assembly of dp-sharded leaves is per-process under "
+                "multi-process offload — read the pushed device params instead")
         if self.nvme_params:
             return self.param_tier.read_master(self._leaf_index[key][li]).copy()
         return self.masters[key][li]
@@ -675,7 +878,18 @@ class ParamOffloadCoordinator:
         for k in self._key_order:
             leaves = jax.tree_util.tree_leaves(tree[k])
             assert len(leaves) == len(self.key_shapes[k]), f"leaf mismatch for {k!r}"
-            if self.nvme_params:
+            if self._partitioned:
+                for li, src in enumerate(leaves):
+                    flat = np.asarray(src, dtype=np.float32).reshape(
+                        self.key_shapes[k][li])
+                    for sid in self._slots_by_leaf[(k, li)]:
+                        nk = self._slot_meta[sid][2]
+                        sl = flat[tuple(slice(a, b) for a, b in nk)].reshape(-1)
+                        if self.nvme_params:
+                            self.param_tier.write_master(sid, sl)
+                        else:
+                            np.copyto(self._masters_p[sid], sl)
+            elif self.nvme_params:
                 for i, src in zip(self._leaf_index[k], leaves):
                     self.param_tier.write_master(
                         i, np.asarray(src, dtype=np.float32).reshape(-1))
@@ -694,7 +908,7 @@ class ParamOffloadCoordinator:
         host RAM (the tier exists because 2× fp32 moments don't fit there). With
         masters themselves on NVMe they are excluded too (streamed by file copy)."""
         sd: Dict[str, Any] = {"step": np.int64(getattr(self, "step_count", 0))}
-        if not self.nvme_params:
+        if not self.nvme_params and not self._partitioned:
             for k in self._key_order:
                 for li, (m, s) in enumerate(zip(self.masters[k],
                                                 self.key_shapes[k])):
@@ -707,9 +921,17 @@ class ParamOffloadCoordinator:
                  float(self.scaler_state.iteration)], np.float64)
         return sd
 
+    def _no_partitioned_state_dict(self):
+        if self._partitioned:
+            raise NotImplementedError(
+                "partitioned (multi-process) offload_param checkpoints through "
+                "per-rank partition files — use save_to/load_from (the engine's "
+                "save_checkpoint/load_checkpoint do), not state_dict")
+
     def state_dict(self) -> dict:
         """Full state incl. moments in host RAM — RAM-mode checkpoints and tests.
         NVMe mode materialises the moment store; use save_to for streaming."""
+        self._no_partitioned_state_dict()
         sd = self._light_state_dict()
         if self.nvme_params:
             for k in self._key_order:
@@ -730,6 +952,7 @@ class ParamOffloadCoordinator:
         return sd
 
     def _restore_masters(self, sd: dict):
+        self._no_partitioned_state_dict()
         for k in self._key_order:
             for li in range(len(self.key_shapes[k])):
                 flat = np.asarray(sd[f"master/{k}/{li}"],
@@ -747,8 +970,9 @@ class ParamOffloadCoordinator:
                 last_overflow_iter=jnp.int32(v[2]), iteration=jnp.int32(v[3]))
 
     def load_state_dict(self, sd: dict):
+        self._no_partitioned_state_dict()
         self._restore_masters(sd)
-        n = len(self.leaf_sizes)
+        n = len(self._flat_sizes)
         if self.nvme is not None:
             self.step_count = int(sd["step"])
             self.nvme.write_moments([np.asarray(sd[f"m/{i}"]) for i in range(n)],
@@ -766,6 +990,29 @@ class ParamOffloadCoordinator:
         self._restore_scaler(sd)
 
     def save_to(self, checkpoint_engine, path: str):
+        if self._partitioned:
+            # one partition file per process (reference per-rank zero_pp_rank_*
+            # files) — resume requires the topology that wrote it
+            rank = jax.process_index()
+            data = {f"master_{i}": m for i, m in
+                    enumerate(self._masters_p or [])}
+            data["step"] = np.int64(getattr(self, "step_count", 0))
+            if self.scaler_state is not None:
+                data["scaler"] = self._light_state_dict()["scaler"]
+            if self.nvme_params:
+                self.param_tier.copy_masters_to(path + f"_masters_p{rank}")
+            if self.nvme is not None:
+                self.nvme.copy_files_to(path + f"_moments_p{rank}")
+            elif self.kind in ("adam", "adamw"):
+                sd = self.opt.state_dict()
+                data["step"] = np.int64(sd["step"])
+                for i, (m, v) in enumerate(zip(sd["m"], sd["v"])):
+                    data[f"m_{i}"], data[f"v_{i}"] = m, v
+            else:
+                for i, s in enumerate(self.sq_sum):
+                    data[f"sq_{i}"] = s
+            np.savez(path + f"_part{rank}.npz", **data)
+            return
         if self.nvme is not None:
             # on-disk state (moments; with nvme_params also masters) is already
             # serialized — stream by file copy, never through host RAM
@@ -781,6 +1028,31 @@ class ParamOffloadCoordinator:
         """Restore masters (always) and optimizer state/scaler (when
         ``load_optimizer_states`` — reference ``load_checkpoint`` honours the same
         flag for fine-tune-from-pretrain restarts)."""
+        if self._partitioned:
+            rank = jax.process_index()
+            with np.load(path + f"_part{rank}.npz") as data:
+                if self.nvme_params:
+                    self.param_tier.copy_masters_from(path + f"_masters_p{rank}")
+                else:
+                    for i, m in enumerate(self._masters_p):
+                        np.copyto(m, data[f"master_{i}"])
+                if load_optimizer_states:
+                    if self.nvme is not None:
+                        self.step_count = int(data["step"])
+                        self.nvme.copy_files_from(path + f"_moments_p{rank}")
+                    elif self.kind in ("adam", "adamw"):
+                        n = len(self._flat_sizes)
+                        self.opt.load_state_dict({
+                            "step": int(data["step"]),
+                            "m": [data[f"m_{i}"] for i in range(n)],
+                            "v": [data[f"v_{i}"] for i in range(n)]})
+                    else:
+                        self.step_count = int(data["step"])
+                        for i, s in enumerate(self.sq_sum):
+                            np.copyto(s, data[f"sq_{i}"])
+                    if "scaler" in data:
+                        self._restore_scaler({"scaler": data["scaler"]})
+            return
         if self.nvme is not None:
             sd = checkpoint_engine.load(path, template=self._light_state_dict())
             if self.nvme_params:

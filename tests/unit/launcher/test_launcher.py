@@ -114,6 +114,25 @@ class TestLocalLaunch:
         assert r0["losses"][-1] < r0["losses"][0]
         assert r0["resumed_loss_finite"] and r1["resumed_loss_finite"]
 
+    def test_two_process_param_offload(self, tmp_path):
+        """Multi-process ZeRO-3 parameter offload (VERDICT r3 item 4): per-process
+        partitioned masters in the segment-streaming tier over a real 2-process
+        mesh; both ranks end with bitwise-identical pushed params, and the
+        per-rank partition files round-trip."""
+        child = os.path.join(REPO, "tests", "unit", "launcher",
+                             "param_offload_train_child.py")
+        proc = self._run_cli(
+            ["--launcher", "local", "--num_procs", "2",
+             "--master_port", str(_free_port()),
+             child, "--out", str(tmp_path)], timeout=420)
+        assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        r0 = eval((tmp_path / "rank0.txt").read_text())
+        r1 = eval((tmp_path / "rank1.txt").read_text())
+        assert r0["digest"] == r1["digest"], (r0, r1)
+        assert r0["losses"] == r1["losses"]
+        assert r0["decreased"] and r1["decreased"]
+        assert r0["resumed_loss_finite"] and r1["resumed_loss_finite"]
+
     def test_failure_propagates(self, tmp_path):
         """A failing rank propagates its exit code through the spawner (reference
         launch.py poll loop)."""
