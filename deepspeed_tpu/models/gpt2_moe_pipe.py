@@ -1,0 +1,110 @@
+"""Pipelined GPT-2-MoE: MoE blocks as first-class 1F1B pipeline body layers.
+
+Pipe×expert composition (reference treats expert groups and pipeline topology as
+composable: ``deepspeed/utils/groups.py:109``, ``runtime/pipe/topology.py:243`` —
+MoE-at-scale trains with experts sharded inside pipeline stages). TPU realisation:
+the 1F1B shard_map goes manual over ``pipe`` only; the ``expert`` axis stays under
+GSPMD, so the MoE layer's sharding-constraint dispatch inserts the expert
+all-to-all INSIDE each stage's forward/backward, and the per-layer load-balancing
+aux losses ride the stage scan → pipe psum → microbatch accumulator into the total
+loss (``PipeLayer.has_aux`` protocol).
+
+The body unit is a dense+MoE PAIR (the reference's alternating NLG architecture,
+``moe_layer_interval=2``) so the stage scan sees a homogeneous parameter stack.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..runtime.pipe.module import (FlaxPipeLayer, LayerSpec, PipelineModule,
+                                   TiedLayerSpec)
+from .gpt2 import Block, cross_entropy_loss
+from .gpt2_moe import GPT2MoEConfig, MoEBlock
+from .gpt2_pipe import GPT2EmbedPipe, GPT2FinalNorm, _tied_head_forward
+
+
+class MoEPairBlock(nn.Module):
+    """One pipeline body unit: dense transformer block followed by an MoE block."""
+    config: GPT2MoEConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        x = Block(self.config, name="dense")(x, deterministic)
+        return MoEBlock(self.config, name="moe")(x, deterministic)
+
+
+class MoEPipeLayer(FlaxPipeLayer):
+    """FlaxPipeLayer + the aux protocol: surfaces the MoE blocks' sown
+    load-balancing losses as the scalar the 1F1B executor aggregates."""
+
+    has_aux = True
+    # expert-weight path components → P(pipe, expert, ...) in param_specs
+    ep_paths = ("experts",)
+
+    def _rngs(self, rng):
+        if rng is None:
+            return {}
+        return {"dropout": rng, "gating": jax.random.fold_in(rng, 7)}
+
+    def init(self, rng, x):
+        rngs = {"params": rng, "dropout": rng, "gating": rng}
+        return self.module.init(rngs, x, **self._kwargs(rng))["params"]
+
+    def apply(self, params, x, rng=None):
+        y, _ = self.module.apply({"params": params}, x, rngs=self._rngs(rng),
+                                 mutable=["losses"], **self._kwargs(rng))
+        return y
+
+    def apply_with_aux(self, params, x, rng=None):
+        y, mut = self.module.apply({"params": params}, x, rngs=self._rngs(rng),
+                                   mutable=["losses"], **self._kwargs(rng))
+        leaves = jax.tree_util.tree_leaves(mut.get("losses", {}))
+        aux = (jnp.sum(jnp.stack([jnp.sum(a) for a in leaves]))
+               if leaves else jnp.float32(0.0))
+        return y, aux.astype(jnp.float32)
+
+
+def _pair_layer(cfg):
+    return MoEPipeLayer(MoEPairBlock(cfg), deterministic_kwarg=True)
+
+
+def _embed_layer(cfg):
+    return FlaxPipeLayer(GPT2EmbedPipe(cfg), deterministic_kwarg=True)
+
+
+def _norm_layer(cfg):
+    return FlaxPipeLayer(GPT2FinalNorm(cfg), deterministic_kwarg=True)
+
+
+def gpt2_moe_pipeline_module(config: GPT2MoEConfig, num_stages: int,
+                             sample_seq_len: Optional[int] = None,
+                             sample_batch_size: int = 1,
+                             activation_checkpoint_interval: int = 1,
+                             partition_method: str = "uniform") -> PipelineModule:
+    """Alternating dense/MoE GPT-2 as a pipeline (``n_layer`` transformer layers =
+    ``n_layer/2`` dense+MoE pair units; requires ``moe_layer_interval == 2`` and
+    even ``n_layer``)."""
+    assert config.moe_layer_interval == 2, \
+        "the pipelined MoE body pairs one dense with one MoE block " \
+        f"(moe_layer_interval=2); got interval {config.moe_layer_interval}"
+    assert config.n_layer % 2 == 0, "n_layer must be even (dense+MoE pairs)"
+    t = sample_seq_len or config.n_positions
+    sample = jnp.zeros((sample_batch_size, t), dtype=jnp.int32)
+    layers = [
+        TiedLayerSpec("embed", _embed_layer, config),
+        *[LayerSpec(_pair_layer, config) for _ in range(config.n_layer // 2)],
+        LayerSpec(_norm_layer, config),
+        TiedLayerSpec("embed", _embed_layer, config, forward_fn=_tied_head_forward),
+    ]
+    return PipelineModule(
+        layers=layers,
+        num_stages=num_stages,
+        loss_fn=cross_entropy_loss,
+        sample_input=sample,
+        partition_method=partition_method,
+        activation_checkpoint_interval=activation_checkpoint_interval,
+        aux_loss_coef=config.moe_loss_coef,
+    )

@@ -56,7 +56,8 @@ class PipelineEngine(DeepSpeedEngine):
             tp_axis = AXIS_TENSOR
         model_obj = model.to_model(mesh_spec=None, name=f"pipe{model.num_stages}",
                                    tp_axis=tp_axis,
-                                   tp_size=getattr(cfg.mesh, "tensor", None))
+                                   tp_size=getattr(cfg.mesh, "tensor", None),
+                                   ep_size=getattr(cfg.mesh, "expert", None))
         super().__init__(args=args, model=model_obj, optimizer=optimizer,
                          model_parameters=model_parameters, training_data=training_data,
                          lr_scheduler=lr_scheduler, mpu=mpu, collate_fn=collate_fn,

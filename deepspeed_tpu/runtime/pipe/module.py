@@ -34,19 +34,29 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ...parallel.mesh import AXIS_PIPE, MeshSpec
+from ...parallel.mesh import AXIS_EXPERT, AXIS_PIPE, MeshSpec
 from ...utils.logging import logger
 
 
 # --------------------------------------------------------------------------- layer contract
 class PipeLayer:
-    """A pipeline layer: ``init(rng, x) -> params`` and ``apply(params, x, rng) -> y``."""
+    """A pipeline layer: ``init(rng, x) -> params`` and ``apply(params, x, rng) -> y``.
+
+    Layers with an auxiliary scalar loss (MoE load-balancing) set ``has_aux = True``
+    and implement ``apply_with_aux(params, x, rng) -> (y, aux)``; the 1F1B executor
+    aggregates aux across layers, stages and microbatches into the total loss
+    (reference MoE aux-loss plumbing through the pipeline engine)."""
+
+    has_aux = False
 
     def init(self, rng, x):
         return {}
 
     def apply(self, params, x, rng=None):
         raise NotImplementedError
+
+    def apply_with_aux(self, params, x, rng=None):
+        return self.apply(params, x, rng), jnp.float32(0.0)
 
 
 class LambdaLayer(PipeLayer):
@@ -218,6 +228,7 @@ class PipelineModule:
                  sample_input=None,
                  partition_method: str = "uniform",
                  activation_checkpoint_interval: int = 0,
+                 aux_loss_coef: float = 0.0,
                  seed: int = 1234):
         if num_stages is None and topology is None:
             raise RuntimeError("must provide num_stages or topology")
@@ -228,6 +239,8 @@ class PipelineModule:
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        # weight of body layers' auxiliary losses (MoE load balancing) in the total
+        self.aux_loss_coef = float(aux_loss_coef)
         self.seed = seed
         assert sample_input is not None, \
             "PipelineModule needs sample_input (abstract is fine) to trace layer shapes"
@@ -351,7 +364,8 @@ class PipelineModule:
         return params
 
     def param_specs(self, abstract_params=None, tp_axis: Optional[str] = None,
-                    tp_size: Optional[int] = None) -> Any:
+                    tp_size: Optional[int] = None,
+                    ep_size: Optional[int] = None) -> Any:
         """PartitionSpec tree: body stacked dim shards over ``pipe``; rest replicated.
 
         With ``tp_axis``, body weights shard per the body layer's Megatron
@@ -364,41 +378,50 @@ class PipelineModule:
         ``tp_size`` defaults to the global mesh's axis size."""
         if abstract_params is None:
             abstract_params = jax.eval_shape(self.init_fn, jax.random.PRNGKey(0))
+        from ...parallel.mesh import get_global_mesh
         if tp_axis and tp_size is None:
-            from ...parallel.mesh import get_global_mesh
             mesh = get_global_mesh()
             tp_size = mesh.size(tp_axis) if mesh is not None else 1
+        if ep_size is None or ep_size < 1:   # None/-1 = unresolved ("infer")
+            gmesh = get_global_mesh()
+            ep_size = gmesh.size(AXIS_EXPERT) if gmesh is not None else 1
         body_layer = self._layers[self.body_start]
         tp_col = tuple(getattr(body_layer, "tp_col", ()))
         tp_row = tuple(getattr(body_layer, "tp_row", ()))
+        ep_paths = tuple(getattr(body_layer, "ep_paths", ()))
         use_rules = bool(tp_axis and tp_size and tp_size > 1 and (tp_col or tp_row))
 
         def body_spec_by_path(path, leaf):
             entries = [AXIS_PIPE] + [None] * (leaf.ndim - 1)
             names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+            if ep_paths and any(n in ep_paths for n in names):
+                # expert-stacked leaf (L_per, e, ...): expert dim over the expert
+                # axis (reference expert-parallel groups, utils/groups.py:109);
+                # non-divisible expert counts replicate, like the TP rules
+                if leaf.ndim >= 2 and ep_size > 1 and \
+                        leaf.shape[1] % ep_size == 0:
+                    entries[1] = AXIS_EXPERT
+                return P(*entries)
             parent = names[-2] if len(names) >= 2 else ""
             kind = names[-1] if names else ""
-            if parent in tp_col and leaf.shape[-1] % tp_size == 0:
+            if use_rules and parent in tp_col and leaf.shape[-1] % tp_size == 0:
                 entries[-1] = tp_axis                     # kernel AND bias follow cols
-            elif parent in tp_row and kind == "kernel" \
+            elif use_rules and parent in tp_row and kind == "kernel" \
                     and leaf.ndim >= 3 and leaf.shape[1] % tp_size == 0:
                 entries[1] = tp_axis                      # first weight dim (inputs)
+            elif not use_rules and tp_axis and leaf.ndim >= 3 and tp_size \
+                    and tp_size > 1 and leaf.shape[-1] % tp_size == 0:
+                entries[-1] = tp_axis                     # generic last-dim fallback
             return P(*entries)
 
         def seg_spec(seg_name):
             def one(leaf):
-                if seg_name == "body":
-                    entries = [AXIS_PIPE] + [None] * (leaf.ndim - 1)
-                    if (tp_axis and leaf.ndim >= 3 and tp_size and tp_size > 1 and
-                            leaf.shape[-1] % tp_size == 0):
-                        entries[-1] = tp_axis
-                    return P(*entries)
                 return P(*([None] * leaf.ndim))
             return one
 
         out = {}
         for seg in ("pre", "body", "post", "tied"):
-            if seg == "body" and use_rules:
+            if seg == "body":
                 out[seg] = jax.tree_util.tree_map_with_path(
                     body_spec_by_path, abstract_params[seg])
             else:
@@ -511,7 +534,8 @@ class PipelineModule:
 
     # ------------------------------------------------------------------ 1F1B
     def make_1f1b_loss_fn(self, mesh_spec: Optional[MeshSpec] = None,
-                          tp_axis: Optional[str] = None):
+                          tp_axis: Optional[str] = None,
+                          aux_loss_coef: Optional[float] = None):
         """Interleaved 1F1B with manual in-loop backward — O(stages) activation memory.
 
         Reference semantics: ``runtime/pipe/engine.py:295`` executing
@@ -553,6 +577,14 @@ class PipelineModule:
         L_per = self.layers_per_stage
         body_layer = self._layers[self.body_start]
         n_layers = len(self._layers)
+        # MoE body layers emit an auxiliary load-balancing scalar per layer; it is
+        # summed over layers in the stage scan, over stages in the final pipe psum,
+        # and over microbatches in the loss accumulator — then weighted by
+        # aux_loss_coef. Dense layers emit 0.0 (DCE'd by XLA).
+        body_aux = bool(getattr(body_layer, "has_aux", False))
+        if aux_loss_coef is None:
+            aux_loss_coef = self.aux_loss_coef
+        aux_coef = jnp.float32(aux_loss_coef)
 
         split_batch = _split_batch
 
@@ -571,7 +603,14 @@ class PipelineModule:
 
         def _layer_apply(tp):
             if tp <= 1 or tp_axis is None:
-                return lambda p, x, r: body_layer.apply(p, x, r)
+                if body_aux:
+                    return lambda p, x, r: body_layer.apply_with_aux(p, x, r)
+                return lambda p, x, r: (body_layer.apply(p, x, r),
+                                        jnp.float32(0.0))
+            assert not body_aux, \
+                ("in-stage tensor parallelism and aux-loss (MoE) body layers are "
+                 "not composed yet — run MoE pipelines with tp_axis=None and "
+                 "shard experts over the expert axis instead")
             if tp not in tp_fns:
                 factory = getattr(body_layer, "tp_apply_factory", None)
                 assert factory is not None, \
@@ -579,7 +618,8 @@ class PipelineModule:
                      "with tp_apply_factory (e.g. gpt2_pipe blocks with "
                      "split_qkv=True)")
                 tp_fns[tp] = factory(tp, tp_axis)
-            return tp_fns[tp]
+            fn = tp_fns[tp]
+            return lambda p, x, r: (fn(p, x, r), jnp.float32(0.0))
 
         def make_stage_fn(tp):
             layer_fn = _layer_apply(tp)
@@ -587,11 +627,12 @@ class PipelineModule:
             def stage_fn(stage_params, x, srng, use_rng):
                 def one(carry, xs_):
                     p, r = xs_
-                    return layer_fn(p, carry, r if use_rng else None), None
+                    y, aux = layer_fn(p, carry, r if use_rng else None)
+                    return y, aux
 
                 rngs = jax.random.split(srng, L_per)
-                y, _ = jax.lax.scan(one, x, (stage_params, rngs))
-                return y
+                y, auxs = jax.lax.scan(one, x, (stage_params, rngs))
+                return y, jnp.sum(auxs).astype(jnp.float32)
             return stage_fn
 
         def idx(tree, m):
@@ -657,18 +698,18 @@ class PipelineModule:
                             pre_p, tied_p, idx(inputs_, mf),
                             jax.random.fold_in(rng_pre, mf) if use_rng else None)
                         x_in = jnp.where(s == 0, x0, recv_f)
-                        y = stage_fn(
+                        y, aux = stage_fn(
                             body_p, x_in,
                             jax.random.fold_in(jax.random.fold_in(rng_body, mf), s),
                             use_rng)
                         return y, jax.lax.dynamic_update_index_in_dim(
-                            stash_in, x_in, mf % S, 0)
+                            stash_in, x_in, mf % S, 0), aux
 
                     def fwd_skip(stash_in, recv_f):
-                        return jnp.zeros_like(recv_f), stash_in
+                        return jnp.zeros_like(recv_f), stash_in, jnp.float32(0.0)
 
-                    y, stash = jax.lax.cond(is_f, fwd_block, fwd_skip,
-                                            carry["stash"], carry["recv_f"])
+                    y, stash, aux_m = jax.lax.cond(is_f, fwd_block, fwd_skip,
+                                                   carry["stash"], carry["recv_f"])
 
                     def tail_block(y_):
                         lab_m = idx(labels_, mf) if labels_ is not None else None
@@ -687,7 +728,8 @@ class PipelineModule:
 
                     loss_m, dpost_m, dtied_tail_m, dy_m = jax.lax.cond(
                         is_f & last, tail_block, tail_skip, y)
-                    loss = carry["loss"] + loss_m
+                    # every stage contributes its own layers' aux on its forward tick
+                    loss = carry["loss"] + loss_m + aux_coef * aux_m
                     dpost = tree_add(carry["dpost"], dpost_m)
                     dtied = tree_add(carry["dtied"], dtied_tail_m)
 
@@ -706,7 +748,9 @@ class PipelineModule:
                                 jax.random.fold_in(jax.random.fold_in(rng_body, mb), s),
                                 use_rng),
                             body_p, x_saved)
-                        dbody_m, dx = svjp(cot_)
+                        # aux output's cotangent is its loss weight: gate/expert
+                        # params receive the load-balancing gradient here
+                        dbody_m, dx = svjp((cot_, aux_coef))
                         return f32_cast(dbody_m), dx.astype(cot_.dtype)
 
                     def bwd_skip(stash_in, cot_):
@@ -798,7 +842,8 @@ class PipelineModule:
     # ------------------------------------------------------------------ model adapter
     def to_model(self, mesh_spec: Optional[MeshSpec] = None, name: str = "pipeline",
                  remat: Optional[bool] = None, schedule: str = "1f1b",
-                 tp_axis: Optional[str] = None, tp_size: Optional[int] = None):
+                 tp_axis: Optional[str] = None, tp_size: Optional[int] = None,
+                 ep_size: Optional[int] = None):
         """Bundle into the engine's :class:`Model` contract. ``loss_fn`` consumes microbatched
         batches ``(inputs, labels)`` with leading dim M and returns mean loss; ``rng=None``
         runs a deterministic (dropout-off) pass.
@@ -815,8 +860,16 @@ class PipelineModule:
         if remat is None:
             remat = self.activation_checkpoint_interval > 0
         assert schedule in ("1f1b", "gpipe"), schedule
-        pipe_loss_1f1b = (self.make_1f1b_loss_fn(mesh_spec, tp_axis=tp_axis)
+        body_has_aux = bool(getattr(self._layers[self.body_start], "has_aux",
+                                    False))
+        pipe_loss_1f1b = (self.make_1f1b_loss_fn(mesh_spec, tp_axis=tp_axis,
+                                                 aux_loss_coef=self.aux_loss_coef)
                           if schedule == "1f1b" and self.num_stages > 1 else None)
+        if body_has_aux and pipe_loss_1f1b is None:
+            raise NotImplementedError(
+                "aux-loss (MoE) body layers train through the 1F1B schedule only "
+                "(the fill-drain/GPipe loop does not aggregate aux losses) — use "
+                "schedule='1f1b' with num_stages > 1")
 
         split_batch = _split_batch
 
@@ -875,7 +928,8 @@ class PipelineModule:
             return self.reference_apply(params, inputs, rng)
 
         return Model(loss_fn=loss_fn, init_fn=self.init_fn, apply_fn=apply_fn,
-                     param_specs=self.param_specs(tp_axis=tp_axis, tp_size=tp_size),
+                     param_specs=self.param_specs(tp_axis=tp_axis, tp_size=tp_size,
+                                                  ep_size=ep_size),
                      name=name)
 
     def __len__(self):

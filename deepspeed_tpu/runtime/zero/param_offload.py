@@ -28,9 +28,11 @@ Peak HBM ≈ 2 segment param slices + boundary activations + one segment's gradi
 independent of total model size, which is the reference's "40B on one V100" recipe
 re-based onto one TPU chip.
 
-Single-controller note: this tier assumes all devices are addressable from this process
-(any chips-per-host). Multi-host pods shard big models over the fsdp axis instead; the
-engine guards on process_count and says so.
+Multi-process runs partition the masters per process along the GRADIENT layout (dim-0
+sharded over the dp axes where divisible): each process initialises, accumulates and
+updates only its devices' unique shards, and the push reconstructs the grad layout and
+reshards to replicated via one jitted all-gather per key (the optimizer tier's recipe
+applied to the streaming tier; reference per-rank cpu offload, ``stage_1_and_2.py:130``).
 """
 
 from typing import Any, Dict, List, Optional
@@ -504,14 +506,17 @@ class ParamOffloadCoordinator:
             gsh = self._gshard[key][li]
             by_idx = {self._slot_meta[sid][2]: sid
                       for sid in self._slots_by_leaf[(key, li)]}
+            cast_cache: Dict[int, np.ndarray] = {}   # slot → cast host array
             singles = []
             for dev, index in gsh.addressable_devices_indices_map(shape).items():
                 nk = _norm_index(index, shape)
                 sid = by_idx[nk]
-                host = cast_master_to(slot_data[sid], self._slot_meta[sid][3],
-                                      self.compute_dtype)
-                nbytes += host.nbytes
-                singles.append(jax.device_put(host, dev))
+                if sid not in cast_cache:
+                    cast_cache[sid] = cast_master_to(
+                        slot_data[sid], self._slot_meta[sid][3],
+                        self.compute_dtype)
+                    nbytes += cast_cache[sid].nbytes
+                singles.append(jax.device_put(cast_cache[sid], dev))
             outs.append(jax.make_array_from_single_device_arrays(
                 shape, gsh, singles))
         tree = jax.tree_util.tree_unflatten(self.key_treedef[key], outs)
