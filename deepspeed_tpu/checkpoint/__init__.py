@@ -1,14 +1,19 @@
-"""Reference-format checkpoint tools (import/inspect Megatron-DeepSpeed runs).
+"""Reference-format checkpoint tools (import/export Megatron-DeepSpeed runs).
 
 Counterpart of ``deepspeed/checkpoint/``: :class:`DeepSpeedCheckpoint` inspects a
 3D (pp × tp × dp) training checkpoint folder, merges tensor-parallel shards, rebuilds
 fp32 weights from ZeRO optimizer shards, and converts Megatron-GPT trees into this
 framework's :mod:`~deepspeed_tpu.models.causal_lm` parameters. THIS framework's own
 checkpoints need none of this — orbax arrays re-shard to any mesh on restore.
+The export direction (:func:`export_universal_checkpoint`,
+:func:`export_fp32_state_dict`) writes a trained engine back out in the reference's
+universal / zero_to_fp32 formats for torch-side consumption.
 """
 
 from .constants import *  # noqa: F401,F403
 from .deepspeed_checkpoint import (DeepSpeedCheckpoint, merge_tp_shards,  # noqa: F401
                                    split_megatron_qkv, to_causal_lm_params)
+from .export import (export_fp32_state_dict,  # noqa: F401
+                     export_universal_checkpoint)
 from .reshape import (Model3DDescriptor, get_model_3d_descriptor,  # noqa: F401
                       get_zero_files, reshape_3d, reshape_meg_2d_parallel)
