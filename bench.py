@@ -43,6 +43,24 @@ def peak_tflops():
     return None
 
 
+def kernel_gate(mode: str):
+    """Compiled-kernel pre-bench check (VERDICT r3 next #8): verify the Mosaic
+    kernels the selected bench relies on against their XLA references ON THE REAL
+    CHIP before any number is recorded, so a kernel regression fails the bench
+    loudly instead of silently benching a fallback. Checks and tolerances live in
+    ``deepspeed_tpu.ops.kernel_checks`` — the SAME source the TPU test lane runs,
+    so the two cannot drift. Returns the per-kernel max-abs-err dict; raises on
+    any failure. No-op (returns None) off-TPU."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
+    from deepspeed_tpu.ops.kernel_checks import run_kernel_checks
+    names = {"train": ("flash_fwd", "flash_bwd", "block_sparse"),
+             "inference": ("flash_fwd", "flash_alibi", "decode")}[mode]
+    return run_kernel_checks(names)
+
+
 def bench_train():
     import numpy as np
 
@@ -126,7 +144,7 @@ def bench_train():
         out["baseline_seq"] = baseline["seq"]
     if peak:
         out["mfu"] = round(tflops_per_chip / peak, 4)
-    print(json.dumps(out))
+    print(json.dumps(_with_gate(out)))
 
 
 def bench_inference():
@@ -201,7 +219,7 @@ def bench_inference():
     }
     if ttft_p50 is not None:
         out["ttft_p50_ms"] = round(ttft_p50, 2)
-    print(json.dumps(out))
+    print(json.dumps(_with_gate(out)))
 
 
 def bench_train_13b():
@@ -291,7 +309,7 @@ def bench_train_13b():
     }
     if peak:
         out["device_compute_mfu"] = round(dev_tps * flops_per_token / 1e12 / peak, 4)
-    print(json.dumps(out))
+    print(json.dumps(_with_gate(out)))
 
 
 def bench_inference_7b():
@@ -403,26 +421,50 @@ def bench_inference_7b():
     }
     if peak:
         out["prefill_mfu"] = round(prefill_tflops / peak, 4)
-    print(json.dumps(out))
+    print(json.dumps(_with_gate(out)))
+
+
+_KERNEL_GATE = None
+
+
+def _with_gate(out: dict) -> dict:
+    if _KERNEL_GATE is not None:
+        out["kernels_ok"] = True
+        out["kernel_max_abs_err"] = {k: round(v, 5)
+                                     for k, v in _KERNEL_GATE.items()}
+    return out
 
 
 def main():
+    global _KERNEL_GATE
     p = argparse.ArgumentParser()
     p.add_argument("--mode", choices=["train", "inference"], default=None,
                    help="defaults to the mode the chosen --model implies")
     p.add_argument("--model", choices=["default", "1.3b", "7b"], default="default",
                    help="north-star shapes: --model 1.3b (train, BASELINE config 3) "
                         "or --model 7b (inference, BASELINE config 5)")
+    p.add_argument("--skip-kernel-gate", action="store_true",
+                   help="skip the compiled-kernel pre-check (debugging only)")
     args = p.parse_args()
+    if args.model == "1.3b" and args.mode == "inference":
+        p.error("--model 1.3b is a training benchmark")
+    if args.model == "7b" and args.mode == "train":
+        p.error("--model 7b is an inference benchmark")
+    mode = "inference" if args.model == "7b" or args.mode == "inference" \
+        else "train"
+    if not args.skip_kernel_gate:
+        try:
+            _KERNEL_GATE = kernel_gate(mode)
+        except Exception as e:
+            print(json.dumps({"metric": "kernel_gate", "value": 0.0, "unit": "ok",
+                              "vs_baseline": 0.0, "kernels_ok": False,
+                              "error": str(e)}))
+            return 1
     if args.model == "1.3b":
-        if args.mode == "inference":
-            p.error("--model 1.3b is a training benchmark")
         bench_train_13b()
     elif args.model == "7b":
-        if args.mode == "train":
-            p.error("--model 7b is an inference benchmark")
         bench_inference_7b()
-    elif (args.mode or "train") == "train":
+    elif mode == "train":
         bench_train()
     else:
         bench_inference()

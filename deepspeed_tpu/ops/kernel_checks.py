@@ -1,0 +1,113 @@
+"""Compiled-kernel parity checks — ONE source of shapes and tolerances.
+
+Shared by the real-TPU test lane (``tests/unit/ops/test_kernels_tpu.py``) and the
+bench's pre-run gate (``bench.py kernel_gate``), so the two cannot drift: a Mosaic
+regression that fails the test suite fails the bench identically. Each check
+compiles the Pallas kernel (no interpret mode) and compares against its XLA
+reference; thresholds are per-check, matched to the check's dtype.
+"""
+
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+
+def _err(a, b) -> float:
+    import jax.numpy as jnp
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+def check_flash_fwd() -> float:
+    import jax
+    import jax.numpy as jnp
+    from .attention.flash import flash_attention
+    from .transformer.attention import xla_attention
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 1024, 4, 64)), jnp.float32)
+               for _ in range(3))
+    o1 = jax.jit(lambda *a: flash_attention(*a, causal=True))(q, k, v)
+    return _err(o1, xla_attention(q, k, v, causal=True))
+
+
+def check_flash_bwd() -> float:
+    import jax
+    import jax.numpy as jnp
+    from .attention.flash import flash_attention
+    from .transformer.attention import xla_attention
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.bfloat16)
+               for _ in range(3))
+    g1 = jax.jit(jax.grad(lambda *a: flash_attention(
+        *a, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(lambda *a: xla_attention(
+        *a, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, k, v)
+    return max(_err(a, b) for a, b in zip(g1, g2))
+
+
+def check_flash_alibi() -> float:
+    import jax
+    import jax.numpy as jnp
+    from ..models.causal_lm import _alibi_attention_xla, alibi_slopes
+    from .attention.flash import flash_attention
+    rng = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.bfloat16)
+               for _ in range(3))
+    slopes = jnp.asarray(alibi_slopes(4))
+    o1 = jax.jit(lambda *a: flash_attention(*a, causal=True,
+                                            alibi_slopes=slopes))(q, k, v)
+    return _err(o1, _alibi_attention_xla(q, k, v, slopes))
+
+
+def check_decode() -> float:
+    import jax
+    import jax.numpy as jnp
+    from .attention.decode import decode_attention, decode_attention_xla
+    rng = np.random.RandomState(0)
+    b, h, hk, d, T = 4, 16, 4, 128, 2048
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
+    kc = jnp.asarray(rng.standard_normal((b, hk, T, d)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((b, hk, T, d)), jnp.bfloat16)
+    lens = jnp.asarray(rng.randint(100, T, size=(b,)), jnp.int32)
+    o1 = jax.jit(decode_attention)(q, kc, vc, lens)
+    return _err(o1, decode_attention_xla(q, kc, vc, lens))
+
+
+def check_block_sparse() -> float:
+    import jax
+    import jax.numpy as jnp
+    from .attention.block_sparse import (block_sparse_attention,
+                                         block_sparse_attention_reference)
+    from .sparse_attention import FixedSparsityConfig
+    rng = np.random.RandomState(0)
+    cfg = FixedSparsityConfig(num_heads=4, block=128, num_local_blocks=2)
+    layout = np.asarray(cfg.make_layout(1024))
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 1024, 4, 128)), jnp.bfloat16)
+               for _ in range(3))
+    o = jax.jit(lambda *a: block_sparse_attention(
+        *a, layout=layout, block=128, causal=True))(q, k, v)
+    return _err(o, block_sparse_attention_reference(q, k, v, layout, 128,
+                                                    causal=True))
+
+
+# name → (check fn, max-abs-err tolerance for the check's dtype/shape)
+KERNEL_CHECKS: Dict[str, Tuple] = {
+    "flash_fwd": (check_flash_fwd, 0.02),       # fp32
+    "flash_bwd": (check_flash_bwd, 0.05),       # bf16 grads
+    "flash_alibi": (check_flash_alibi, 0.05),   # bf16
+    "decode": (check_decode, 0.03),             # bf16
+    "block_sparse": (check_block_sparse, 0.03),  # bf16
+}
+
+
+def run_kernel_checks(names: Optional[Iterable[str]] = None) -> Dict[str, float]:
+    """Run the named checks (all by default); returns {name: max_abs_err}.
+    Raises RuntimeError listing every check whose error exceeds its tolerance."""
+    errs, bad = {}, {}
+    for name in (names or KERNEL_CHECKS):
+        fn, tol = KERNEL_CHECKS[name]
+        errs[name] = fn()
+        if not (errs[name] < tol):      # NaN-safe
+            bad[name] = (errs[name], tol)
+    if bad:
+        raise RuntimeError(f"kernel checks FAILED (err, tol): {bad}")
+    return errs

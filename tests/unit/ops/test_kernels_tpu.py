@@ -1,73 +1,55 @@
 """Real-TPU compiled-kernel correctness (skipped on CPU, where kernels run in
 interpreter mode and a Mosaic regression would go unseen — VERDICT r2 weak item 6).
 
+The check bodies and tolerances live in ``deepspeed_tpu.ops.kernel_checks`` — the
+SAME source bench.py's pre-run kernel gate executes every round, so the test lane
+and the driver-visible gate cannot drift.
+
 Run on a TPU host with ``python -m pytest tests/unit/ops/test_kernels_tpu.py -p
 no:cacheprovider`` OUTSIDE the CPU-pinning conftest, or drive via
 ``python tests/unit/ops/test_kernels_tpu.py`` directly.
 """
 
-import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
+
+from deepspeed_tpu.ops.kernel_checks import KERNEL_CHECKS, run_kernel_checks
 
 pytestmark = pytest.mark.skipif(jax.default_backend() != "tpu",
                                 reason="compiled-kernel checks need a TPU")
 
 
-def test_decode_kernel_compiled():
-    from deepspeed_tpu.ops.attention.decode import (decode_attention,
-                                                    decode_attention_xla)
-    rng = np.random.RandomState(0)
-    b, h, hk, d, T = 4, 16, 4, 128, 2048
-    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.bfloat16)
-    kc = jnp.asarray(rng.standard_normal((b, hk, T, d)), jnp.bfloat16)
-    vc = jnp.asarray(rng.standard_normal((b, hk, T, d)), jnp.bfloat16)
-    lens = jnp.asarray(rng.randint(100, T, size=(b,)), jnp.int32)
-    o1 = jax.jit(decode_attention)(q, kc, vc, lens)
-    o2 = decode_attention_xla(q, kc, vc, lens)
-    err = float(jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32))))
-    assert err < 0.03, err
+@pytest.mark.parametrize("name", sorted(KERNEL_CHECKS))
+def test_kernel_compiled(name):
+    errs = run_kernel_checks([name])
+    assert name in errs
 
 
-def test_block_sparse_kernel_compiled():
-    from deepspeed_tpu.ops.attention.block_sparse import (
-        block_sparse_attention, block_sparse_attention_reference)
-    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
-    rng = np.random.RandomState(0)
-    cfg = FixedSparsityConfig(num_heads=4, block=128, num_local_blocks=2)
-    layout = np.asarray(cfg.make_layout(1024))
-    q = jnp.asarray(rng.standard_normal((2, 1024, 4, 128)), jnp.bfloat16)
-    k = jnp.asarray(rng.standard_normal((2, 1024, 4, 128)), jnp.bfloat16)
-    v = jnp.asarray(rng.standard_normal((2, 1024, 4, 128)), jnp.bfloat16)
-    o = jax.jit(lambda *a: block_sparse_attention(
-        *a, layout=layout, block=128, causal=True))(q, k, v)
-    ref = block_sparse_attention_reference(q, k, v, layout, 128, causal=True)
-    err = float(jnp.max(jnp.abs(o.astype(jnp.float32) - ref.astype(jnp.float32))))
-    assert err < 0.03, err
-
-
-def test_flash_kernel_compiled():
-    from deepspeed_tpu.ops.attention.flash import flash_attention
+def test_ring_kernel_compiled():
+    """Ring attention is mesh-level (not in the single-chip gate): compiled run
+    over a 1-device seq mesh must match XLA."""
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.attention.ring import ring_attention
     from deepspeed_tpu.ops.transformer.attention import xla_attention
-    rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.standard_normal((2, 1024, 4, 64)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((2, 1024, 4, 64)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((2, 1024, 4, 64)), jnp.float32)
-    o1 = jax.jit(lambda *a: flash_attention(*a, causal=True))(q, k, v)
+    from deepspeed_tpu.parallel.mesh import MeshSpec, set_global_mesh
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.float32)
+               for _ in range(3))
+    set_global_mesh(MeshSpec({"seq": 1}, jax.devices()[:1]))
+    try:
+        o1 = jax.jit(lambda *a: ring_attention(*a, causal=True))(q, k, v)
+    finally:
+        set_global_mesh(None)
     o2 = xla_attention(q, k, v, causal=True)
-    assert float(jnp.max(jnp.abs(o1 - o2))) < 0.02
-    g1 = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
-        flash_attention(q, k, v, causal=True) * v), argnums=(0, 1, 2)))(q, k, v)
-    g2 = jax.grad(lambda q, k, v: jnp.sum(
-        xla_attention(q, k, v, causal=True) * v), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g1, g2):
-        assert float(jnp.max(jnp.abs(a - b))) < 0.05
+    err = float(jnp.max(jnp.abs(o1 - o2)))
+    assert err < 0.02, err
 
 
 if __name__ == "__main__":
-    for fn in (test_decode_kernel_compiled, test_block_sparse_kernel_compiled,
-               test_flash_kernel_compiled):
-        fn()
-        print(f"{fn.__name__}: OK")
+    for name in sorted(KERNEL_CHECKS):
+        errs = run_kernel_checks([name])
+        print(f"{name}: max abs err {errs[name]:.5f} OK")
+    test_ring_kernel_compiled()
+    print("ring: OK")
