@@ -30,6 +30,17 @@ DEFAULT_TUNING_SPACE = {
     "zero_optimization.stage": [0, 1, 2, 3],
 }
 
+# metric name → record key; one definition shared by the in-process measure path
+# and subprocess best-selection (config.py's validator lists the same names)
+METRIC_KEYS = {"latency": "latency_s", "throughput": "throughput",
+               "flops": "flops"}
+
+
+def metric_value(metric: str, record: Dict) -> float:
+    """Signed metric for maximisation (latency negated)."""
+    v = float(record[METRIC_KEYS[metric]])
+    return -v if metric == "latency" else v
+
 
 class Autotuner:
     """``engine_factory(overrides: dict) -> engine`` builds a fresh engine with the
@@ -121,8 +132,6 @@ class Autotuner:
                             f"{est/1e9:.2f}GB > HBM {self.hbm_bytes/1e9:.2f}GB")
                 self.records.append({"exp": overrides, "status": "pruned"})
                 return None
-        metric_key = {"latency": "latency_s", "throughput": "throughput",
-                      "flops": "flops"}[self.cfg.metric]
         try:
             engine = self.engine_factory(overrides)
             batch = self.batch_factory(engine.train_batch_size())
@@ -145,8 +154,7 @@ class Autotuner:
             log_dist(f"[autotuner] {overrides} -> {samples_per_sec:.1f} samples/s "
                      f"({dt*1e3:.1f} ms/step)", ranks=[0])
             del engine
-            val = record[metric_key]
-            return -val if self.cfg.metric == "latency" else val
+            return metric_value(self.cfg.metric, record)
         except Exception as e:  # XLA RESOURCE_EXHAUSTED and friends
             logger.warning(f"[autotuner] {overrides} failed: {e}")
             self.records.append({"exp": overrides, "status": "failed",
@@ -154,17 +162,54 @@ class Autotuner:
             return None
 
     # ------------------------------------------------------------------ entry
+    def _tune_subprocess(self, exps: List[Dict]) -> Optional[Dict]:
+        """Crash-isolated parallel trials through the ExperimentScheduler
+        (reference ResourceManager). Memory pruning still happens in-process;
+        surviving experiments all launch (grid semantics — the scheduler's
+        parallelism replaces the sequential tuner strategies)."""
+        from .scheduler import ExperimentScheduler
+        n_params = self.model_info.get("num_params")
+        survivors = []
+        for ovr in exps:
+            if n_params and self.hbm_bytes and \
+                    self._estimate_bytes(ovr, n_params) > self.hbm_bytes:
+                self.records.append({"exp": ovr, "status": "pruned"})
+                continue
+            survivors.append(ovr)
+        sched = ExperimentScheduler(
+            self.cfg.experiment_runner, self.base_config,
+            results_dir=self.cfg.results_dir,
+            timeout_s=self.cfg.experiment_timeout_s,
+            max_parallel=self.cfg.max_parallel_experiments)
+        recs = sched.run(survivors)
+        self.records.extend(recs)
+        ok = [r for r in recs
+              if r.get("status") == "ok" and METRIC_KEYS[self.cfg.metric] in r]
+        if not ok:
+            return None
+        return max(ok, key=lambda r: metric_value(self.cfg.metric, r))["exp"]
+
     def tune(self) -> Optional[Dict]:
         """Run the search; returns the best overrides dict (reference
         ``Autotuner.tune``) and writes ``results_dir/autotuning_results.json``."""
-        self._profile_model()
+        if self.cfg.experiment_runner:
+            # subprocess mode exists because in-process engine builds may hard-
+            # crash — do NOT build the profile engine here either; take the
+            # param count from config (reference model_info block) when present,
+            # else skip memory pruning and let infeasible configs fail isolated
+            self.model_info = dict(self.cfg.model_info or {})
+        else:
+            self._profile_model()
         exps = self.tuning_space()
         log_dist(f"[autotuner] exploring {len(exps)} configurations "
                  f"({self.cfg.tuner_type})", ranks=[0])
-        tuner = make_tuner(self.cfg.tuner_type, exps, self.cfg.metric)
-        best = tuner.tune(self._measure, sample_size=1,
-                          n_trials=self.cfg.tuner_num_trials,
-                          early_stopping=self.cfg.tuner_early_stopping)
+        if self.cfg.experiment_runner:
+            best = self._tune_subprocess(exps)
+        else:
+            tuner = make_tuner(self.cfg.tuner_type, exps, self.cfg.metric)
+            best = tuner.tune(self._measure, sample_size=1,
+                              n_trials=self.cfg.tuner_num_trials,
+                              early_stopping=self.cfg.tuner_early_stopping)
         os.makedirs(self.cfg.results_dir, exist_ok=True)
         out_path = os.path.join(self.cfg.results_dir, "autotuning_results.json")
         with open(out_path, "w") as f:

@@ -40,6 +40,15 @@ class AutotuningConfig(ConfigModel):
     # tuning-space overrides with DOTTED flat keys mapping to candidate value lists,
     # e.g. {"zero_optimization.stage": [0, 1, 3]}
     tuning_space: Dict[str, Any] = Field(default_factory=dict)
+    # subprocess experiment scheduler (reference autotuning/scheduler.py
+    # ResourceManager): a runner MODULE name switches trials from in-process to
+    # crash-isolated subprocesses run max_parallel at a time (see scheduler.py)
+    experiment_runner: Optional[str] = None
+    experiment_timeout_s: float = Field(600.0, gt=0)
+    max_parallel_experiments: int = Field(1, gt=0)
+    # reference "model_info" block: {"num_params": N} enables memory pruning in
+    # subprocess mode without an in-process profile engine build
+    model_info: Dict[str, Any] = Field(default_factory=dict)
 
     @field_validator("metric")
     @classmethod

@@ -1,0 +1,39 @@
+"""Test runner module for the subprocess ExperimentScheduler.
+
+Implements the scheduler's runner protocol without building a real engine:
+behavior is keyed by the overrides dict — ``{"behavior": "ok", "value": N}``
+reports a measurement, ``"crash"`` hard-exits (the failure mode the in-process
+measure path cannot survive), ``"hang"`` sleeps past any test timeout.
+"""
+
+import argparse
+import json
+import os
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", required=True)
+    p.add_argument("--overrides", required=True)
+    p.add_argument("--out", required=True)
+    args = p.parse_args()
+    with open(args.overrides) as f:
+        ovr = json.load(f)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    behavior = ovr.get("behavior", "ok")
+    if behavior == "crash":
+        os._exit(9)                     # hard death, no Python cleanup
+    if behavior == "hang":
+        time.sleep(120)
+    value = float(ovr.get("value", 1.0))
+    with open(args.out, "w") as f:
+        json.dump({"status": "ok", "latency_s": 1.0 / value,
+                   "throughput": value, "flops": value * 10,
+                   "seen_config": sorted(cfg.keys()),
+                   "slot_tag": os.environ.get("DS_TPU_SLOT_TAG", "")}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -112,6 +112,70 @@ class TestSpace:
         assert at.records[-1]["status"] == "pruned"
 
 
+class TestExperimentScheduler:
+    """Subprocess experiment scheduler (reference autotuning/scheduler.py
+    ResourceManager): crash isolation, timeouts, parallel slots."""
+
+    def _sched(self, tmp_path, **kw):
+        from deepspeed_tpu.autotuning.scheduler import ExperimentScheduler
+        kw.setdefault("results_dir", str(tmp_path))
+        return ExperimentScheduler("tests.unit.autotuning.fake_runner",
+                                   {"train_batch_size": 8}, **kw)
+
+    def test_crash_isolation_and_results(self, tmp_path):
+        """A hard-exiting experiment (os._exit — the failure the in-process
+        measure path cannot survive) yields a failed record; the others finish."""
+        sched = self._sched(tmp_path, timeout_s=60)
+        recs = sched.run([{"behavior": "ok", "value": 2.0},
+                          {"behavior": "crash"},
+                          {"behavior": "ok", "value": 5.0}])
+        assert [r["status"] for r in recs] == ["ok", "failed", "ok"]
+        assert recs[1]["returncode"] == 9
+        assert recs[2]["throughput"] == 5.0
+        assert recs[0]["seen_config"] == ["train_batch_size"]
+
+    def test_timeout_kills_hung_experiment(self, tmp_path):
+        # timeout must exceed interpreter startup (site hooks import jax, ~5 s)
+        # while staying far below the runner's 120 s hang
+        sched = self._sched(tmp_path, timeout_s=15)
+        recs = sched.run([{"behavior": "hang"}, {"behavior": "ok", "value": 1.0}])
+        assert recs[0]["status"] == "timeout"
+        assert recs[0]["wall_s"] >= 15
+        assert recs[1]["status"] == "ok"
+
+    def test_parallel_slots_with_env_overlays(self, tmp_path):
+        sched = self._sched(
+            tmp_path, timeout_s=60, max_parallel=2,
+            slot_envs=[{"DS_TPU_SLOT_TAG": "a"}, {"DS_TPU_SLOT_TAG": "b"}])
+        recs = sched.run([{"behavior": "ok", "value": v} for v in (1, 2, 3, 4)])
+        assert all(r["status"] == "ok" for r in recs)
+        assert {r["slot_tag"] for r in recs} == {"a", "b"}
+
+    def test_autotuner_subprocess_mode_selects_best(self, tmp_path):
+        """End-to-end: Autotuner with experiment_runner set schedules all
+        surviving experiments and picks the best by metric, surviving a crash."""
+        cfg = {"train_batch_size": 8,
+               "autotuning": {"tuning_space": {
+                   "behavior": ["ok", "crash"], "value": [2.0, 7.0]}}}
+        at_cfg = AutotuningConfig(
+            enabled=True, results_dir=str(tmp_path),
+            experiment_runner="tests.unit.autotuning.fake_runner",
+            experiment_timeout_s=60, max_parallel_experiments=2,
+            min_train_micro_batch_size_per_gpu=1,
+            max_train_micro_batch_size_per_gpu=1,
+            tuning_space={"behavior": ["ok", "crash"], "value": [2.0, 7.0]})
+        at = Autotuner(cfg, lambda ovr: (_ for _ in ()).throw(
+            AssertionError("in-process factory must not run in subprocess mode")),
+            lambda bs: None, at_cfg)
+        best = at.tune()
+        assert best is not None and best["behavior"] == "ok"
+        assert best["value"] == 7.0
+        results = json.loads((tmp_path / "autotuning_results.json").read_text())
+        statuses = sorted(r["status"] for r in results["records"])
+        assert statuses.count("failed") == 2      # the two crash configs
+        assert statuses.count("ok") == 2
+
+
 class TestEndToEnd:
     def test_tune_simple_model(self, tmp_path):
         cfg = base_config(batch_size=16, stage=0)
