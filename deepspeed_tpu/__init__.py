@@ -58,7 +58,12 @@ def initialize(args=None,
 
 
 def init_inference(model, config=None, **kwargs):
-    """Create an inference engine. Reference: ``deepspeed/__init__.py:init_inference:233``."""
+    """Create an inference engine. Reference: ``deepspeed/__init__.py:init_inference:233``.
+
+    Decoder (CausalLM) models serve through :class:`InferenceEngine` (KV-cache
+    generation); encoder models (BERT/DistilBERT configs or HF modules) through
+    :class:`EncoderInferenceEngine` (whole-sequence forward) — the reference's
+    bert/distil_bert injection containers."""
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
 
@@ -67,6 +72,18 @@ def init_inference(model, config=None, **kwargs):
     if isinstance(config, dict):
         config.update({k: v for k, v in kwargs.items() if v is not None})
         config = DeepSpeedInferenceConfig(**config)
+
+    from .models.encoder import EncoderConfig
+    is_encoder = isinstance(model, EncoderConfig)
+    if not is_encoder:
+        try:
+            from .module_inject.encoder_policies import is_hf_encoder
+            is_encoder = is_hf_encoder(model)
+        except ImportError:
+            pass
+    if is_encoder:
+        from .inference.encoder_engine import EncoderInferenceEngine
+        return EncoderInferenceEngine(model, config)
     return InferenceEngine(model, config)
 
 

@@ -58,7 +58,11 @@ class Autotuner:
             **base_config.get("autotuning", {}))
         self.engine_factory = engine_factory
         self.batch_factory = batch_factory
-        self.hbm_bytes = hbm_bytes or self._detect_hbm()
+        # subprocess mode must NOT touch jax in the tuner process (acquiring the
+        # TPU here would make every isolated runner fail device init) — pruning
+        # then needs hbm_bytes passed explicitly
+        self.hbm_bytes = hbm_bytes or (
+            None if self.cfg.experiment_runner else self._detect_hbm())
         self.records: List[Dict] = []
         self.model_info: Dict[str, Any] = {}
 
@@ -82,14 +86,22 @@ class Autotuner:
         del engine
         return n_params
 
+    def _n_devices(self) -> int:
+        if self.cfg.experiment_runner:
+            # stay off jax in the tuner process (see __init__); shard estimates
+            # fall back to 1 (conservative: over-estimates per-device bytes)
+            return 1
+        return jax.device_count()
+
     def _estimate_bytes(self, overrides: Dict, n_params: int) -> float:
         """Reference ``memory_estimation`` arithmetic: 16 bytes/param (bf16 weight+grad
         + fp32 master+m+v) with the optimizer/master tier divided by ZeRO shards."""
         stage = overrides.get("zero_optimization.stage",
                               self.base_config.get("zero_optimization", {})
                               .get("stage", 0))
-        shards = jax.device_count() if stage >= 1 else 1
-        param_shards = jax.device_count() if stage >= 3 else 1
+        n_dev = self._n_devices()
+        shards = n_dev if stage >= 1 else 1
+        param_shards = n_dev if stage >= 3 else 1
         fixed = n_params * (4.0 / param_shards + 12.0 / shards)
         micro = overrides.get("train_micro_batch_size_per_gpu", 1)
         act = self.model_info.get("activation_bytes_per_sample", 0) * micro

@@ -1,0 +1,84 @@
+"""Encoder inference engine: TP-sharded whole-sequence serving.
+
+The bidirectional counterpart of :class:`~.engine.InferenceEngine` (reference
+``InferenceEngine`` serving injected BERT/DistilBERT containers,
+``module_inject/containers/bert.py``). No KV cache or generation loop — one
+jitted forward over params sharded per the Megatron encoder rules; ``forward``
+returns HF-shaped ``(last_hidden_state, pooler_output)``.
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.encoder import EncoderConfig, EncoderLM, encoder_param_specs
+from ..parallel.mesh import AXIS_DATA, AXIS_TENSOR, MeshSpec, set_global_mesh
+from ..utils.logging import log_dist
+
+
+class EncoderInferenceEngine:
+    def __init__(self, model, config=None, params: Optional[Any] = None,
+                 mesh_spec: Optional[MeshSpec] = None, seed: int = 0):
+        from .config import DeepSpeedInferenceConfig
+        self._config = config or DeepSpeedInferenceConfig()
+        tp = self._config.resolved_tp()
+        dp = max(1, int(self._config.data_parallel))
+        self.mesh_spec = mesh_spec or MeshSpec(
+            {AXIS_TENSOR: tp, AXIS_DATA: dp}, devices=jax.devices()[:tp * dp])
+        set_global_mesh(self.mesh_spec)
+
+        if isinstance(model, EncoderConfig):
+            self.model_config = model
+            if params is None:
+                module = EncoderLM(model)
+                params = jax.jit(lambda r: module.init(
+                    {"params": r}, jnp.zeros((1, 8), jnp.int32))["params"])(
+                        jax.random.PRNGKey(seed))
+        else:
+            from ..module_inject.encoder_policies import convert_hf_encoder
+            self.model_config, params = convert_hf_encoder(model)
+        self.dtype = self._config.jax_dtype()
+        self.model_config.dtype = self.dtype
+        self.module = EncoderLM(self.model_config)
+        self.params = self._place_params(params)
+        self._fns: Dict[str, Any] = {}
+        log_dist(f"encoder inference engine ready: {self.model_config.name} "
+                 f"params≈{self.model_config.num_params():,} tp={tp} dp={dp} "
+                 f"dtype={self.dtype.__name__}", ranks=[0])
+
+    def _place_params(self, raw):
+        from .engine import spec_fits
+        specs = encoder_param_specs(raw, tensor_axis=AXIS_TENSOR)
+        mesh = self.mesh_spec
+
+        def put(arr, spec):
+            arr = jnp.asarray(arr)
+            if arr.ndim >= 2 and arr.dtype in (jnp.float32, jnp.float16,
+                                               jnp.bfloat16):
+                arr = arr.astype(self.dtype)
+            if not spec_fits(mesh, arr.shape, spec):
+                spec = P(*([None] * arr.ndim))
+            return jax.device_put(arr, NamedSharding(mesh.mesh, spec))
+
+        return jax.tree_util.tree_map(put, raw, specs,
+                                      is_leaf=lambda x: not isinstance(x, dict))
+
+    def forward(self, input_ids, attention_mask=None, token_type_ids=None,
+                **kwargs):
+        """(last_hidden_state, pooler_output or None) — HF BertModel surface."""
+        set_global_mesh(self.mesh_spec)
+        if "fwd" not in self._fns:
+            self._fns["fwd"] = jax.jit(
+                lambda p, ids, am, tt: self.module.apply(
+                    {"params": p}, ids, attention_mask=am, token_type_ids=tt))
+        ids = jnp.asarray(np.asarray(input_ids))
+        am = None if attention_mask is None else \
+            jnp.asarray(np.asarray(attention_mask))
+        tt = None if token_type_ids is None else \
+            jnp.asarray(np.asarray(token_type_ids))
+        return self._fns["fwd"](self.params, ids, am, tt)
+
+    __call__ = forward

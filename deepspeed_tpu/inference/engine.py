@@ -29,6 +29,20 @@ from ..utils.logging import log_dist, logger
 from .config import DeepSpeedInferenceConfig
 
 
+def spec_fits(mesh_spec, shape, spec) -> bool:
+    """Every named axis (incl. tuple entries) divides its dimension — the shared
+    placement guard of the decoder and encoder serving engines (non-divisible
+    leaves fall back to replication instead of crashing device_put)."""
+    for i, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            if shape[i] % mesh_spec.size(ax) != 0:
+                return False
+    return True
+
+
 class InferenceEngine:
     """Serve a :class:`CausalLM` (or anything converted to one by ``module_inject``)."""
 
@@ -104,15 +118,7 @@ class InferenceEngine:
         return params
 
     def _spec_fits(self, shape, spec) -> bool:
-        mesh = self.mesh_spec
-        for i, entry in enumerate(tuple(spec)):
-            if entry is None:
-                continue
-            axes = entry if isinstance(entry, tuple) else (entry,)
-            for ax in axes:
-                if shape[i] % mesh.size(ax) != 0:
-                    return False
-        return True
+        return spec_fits(self.mesh_spec, shape, spec)
 
     # weight-path names eligible for int8 quantization (matmul kernels; embeddings and
     # norms stay in fp — reference GroupQuantizer quantizes the same set)

@@ -1,0 +1,171 @@
+"""Bidirectional encoder family (BERT / DistilBERT) for injected inference.
+
+Reference: the encoder injection containers
+(``deepspeed/module_inject/containers/bert.py:1``, ``distil_bert.py:1``) — the
+non-generative half of the reference's inference-kernel surface. TPU-native
+redesign mirrors :mod:`causal_lm`: ONE configurable post-LN encoder covers the
+family; per-family constructors pin the knobs; per-family policies in
+``module_inject`` map HF weights onto it with output parity.
+
+Encoders serve whole sequences in one forward (no KV cache / generation), so the
+serving path is a TP-sharded jitted ``forward`` — flash attention is available
+but full-sequence bidirectional attention on short encoder inputs is already
+MXU-friendly under plain XLA.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class EncoderConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2          # 0 → no token-type embeddings (DistilBERT)
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    d_ff: Optional[int] = None        # None → 4*n_embd
+    activation: str = "gelu"
+    ln_eps: float = 1e-12
+    pooler: bool = True               # BERT pooler head; DistilBERT has none
+    dtype: Any = jnp.float32
+    init_std: float = 0.02
+    name: str = "encoder"
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff or 4 * self.n_embd
+
+    def num_params(self) -> int:
+        d, L, f = self.n_embd, self.n_layer, self.ffn_dim
+        emb = (self.vocab_size + self.max_seq_len + self.type_vocab_size) * d
+        layer = 4 * d * d + 2 * d * f
+        return emb + L * layer + (d * d if self.pooler else 0)
+
+
+def bert_cfg(**kw) -> EncoderConfig:
+    kw.setdefault("name", "bert")
+    return EncoderConfig(**kw)
+
+
+def distilbert_cfg(**kw) -> EncoderConfig:
+    kw.setdefault("type_vocab_size", 0)
+    kw.setdefault("pooler", False)
+    kw.setdefault("name", "distilbert")
+    return EncoderConfig(**kw)
+
+
+def _act(cfg: EncoderConfig):
+    return {"gelu": partial(nn.gelu, approximate=False), "relu": nn.relu}[
+        cfg.activation]
+
+
+class EncoderLayer(nn.Module):
+    """Post-LN transformer encoder layer (BERT layout: residual then LayerNorm)."""
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias):
+        cfg = self.config
+        b, t, d = x.shape
+        h = cfg.n_head
+        hd = cfg.head_dim
+        init = nn.initializers.normal(cfg.init_std)
+        q = nn.Dense(d, dtype=cfg.dtype, kernel_init=init, name="q_proj")(x)
+        k = nn.Dense(d, dtype=cfg.dtype, kernel_init=init, name="k_proj")(x)
+        v = nn.Dense(d, dtype=cfg.dtype, kernel_init=init, name="v_proj")(x)
+        q = q.reshape(b, t, h, hd)
+        k = k.reshape(b, t, h, hd)
+        v = v.reshape(b, t, h, hd)
+        logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        logits = logits / np.sqrt(hd) + mask_bias
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(b, t, d)
+        o = nn.Dense(d, dtype=cfg.dtype, kernel_init=init, name="o_proj")(o)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                         name="ln_attn")(x + o).astype(cfg.dtype)
+
+        hmid = nn.Dense(cfg.ffn_dim, dtype=cfg.dtype, kernel_init=init,
+                        name="fc_in")(x)
+        hmid = _act(cfg)(hmid)
+        y = nn.Dense(d, dtype=cfg.dtype, kernel_init=init, name="fc_out")(hmid)
+        return nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                            name="ln_mlp")(x + y).astype(cfg.dtype)
+
+
+class EncoderLM(nn.Module):
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        """Returns ``(last_hidden_state, pooler_output or None)``.
+
+        ``attention_mask``: HF-style (b, t) 1/0 — 0 keys are masked out for every
+        query (additive -inf bias, the HF ``get_extended_attention_mask``)."""
+        cfg = self.config
+        b, t = input_ids.shape
+        init = nn.initializers.normal(cfg.init_std)
+        wte = self.param("wte", init, (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", init, (cfg.max_seq_len, cfg.n_embd), jnp.float32)
+        x = wte[input_ids] + wpe[:t][None]
+        if cfg.type_vocab_size > 0:
+            tte = self.param("tte", init, (cfg.type_vocab_size, cfg.n_embd),
+                             jnp.float32)
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + tte[token_type_ids]
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
+                         name="ln_embed")(x).astype(cfg.dtype)
+
+        if attention_mask is None:
+            mask_bias = jnp.zeros((b, 1, 1, t), jnp.float32)
+        else:
+            mask_bias = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                                  0.0, -1e30).astype(jnp.float32)
+        for i in range(cfg.n_layer):
+            x = EncoderLayer(cfg, name=f"layers_{i}")(x, mask_bias)
+
+        pooled = None
+        if cfg.pooler:
+            pooled = jnp.tanh(nn.Dense(cfg.n_embd, dtype=cfg.dtype,
+                                       kernel_init=init,
+                                       name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+def encoder_param_specs(params, tensor_axis: str = "tensor") -> Any:
+    """Megatron TP rules for :class:`EncoderLM` (same classification the CausalLM
+    serving path uses: q/k/v/fc_in column-parallel, o/fc_out row-parallel)."""
+    col = ("q_proj", "k_proj", "v_proj", "fc_in")
+    row = ("o_proj", "fc_out")
+
+    def spec_for(path_str: str, ndim: int):
+        if any(f"/{n}/" in path_str for n in col):
+            if path_str.endswith("kernel"):
+                return P(None, tensor_axis)
+            return P(tensor_axis)
+        if any(f"/{n}/" in path_str for n in row):
+            if path_str.endswith("kernel"):
+                return P(tensor_axis, None)
+            return P(*([None] * ndim)) if ndim else P()
+        return P(*([None] * ndim)) if ndim else P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        path_str = "/".join(str(getattr(kk, "key", getattr(kk, "idx", kk)))
+                            for kk in path)
+        specs.append(spec_for(path_str, getattr(leaf, "ndim", 0)))
+    return jax.tree_util.tree_unflatten(treedef, specs)

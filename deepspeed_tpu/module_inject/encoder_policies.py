@@ -1,0 +1,110 @@
+"""Encoder injection policies: HF BERT / DistilBERT → :class:`EncoderLM` params.
+
+Reference ``module_inject/containers/bert.py:1`` + ``distil_bert.py:1``
+(``replace_policy.py`` registry): the weight-layout converters for the
+bidirectional half of the injection surface. Outputs are parity-checked against
+the HF modules (``tests/unit/inference/test_encoder_inference.py``).
+"""
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..models.encoder import EncoderConfig, bert_cfg, distilbert_cfg
+from ..utils.logging import logger
+
+
+def _t(w) -> np.ndarray:
+    """torch Linear weight (out, in) → flax Dense kernel (in, out)."""
+    return np.ascontiguousarray(w.detach().cpu().numpy().T.astype(np.float32))
+
+
+def _v(w) -> np.ndarray:
+    return np.ascontiguousarray(w.detach().cpu().numpy().astype(np.float32))
+
+
+def _dense(lin) -> Dict[str, np.ndarray]:
+    return {"kernel": _t(lin.weight), "bias": _v(lin.bias)}
+
+
+def _ln(ln) -> Dict[str, np.ndarray]:
+    return {"scale": _v(ln.weight), "bias": _v(ln.bias)}
+
+
+def convert_bert(model) -> Tuple[EncoderConfig, Any]:
+    """HF ``BertModel`` (or the encoder inside ``BertFor*``) → EncoderLM."""
+    if hasattr(model, "bert"):
+        model = model.bert
+    hf = model.config
+    cfg = bert_cfg(vocab_size=hf.vocab_size,
+                   max_seq_len=hf.max_position_embeddings,
+                   type_vocab_size=hf.type_vocab_size,
+                   n_embd=hf.hidden_size, n_layer=hf.num_hidden_layers,
+                   n_head=hf.num_attention_heads,
+                   d_ff=hf.intermediate_size, ln_eps=hf.layer_norm_eps,
+                   pooler=model.pooler is not None)
+    emb = model.embeddings
+    params: Dict[str, Any] = {
+        "wte": _v(emb.word_embeddings.weight),
+        "wpe": _v(emb.position_embeddings.weight),
+        "tte": _v(emb.token_type_embeddings.weight),
+        "ln_embed": _ln(emb.LayerNorm),
+    }
+    for i, layer in enumerate(model.encoder.layer):
+        params[f"layers_{i}"] = {
+            "q_proj": _dense(layer.attention.self.query),
+            "k_proj": _dense(layer.attention.self.key),
+            "v_proj": _dense(layer.attention.self.value),
+            "o_proj": _dense(layer.attention.output.dense),
+            "ln_attn": _ln(layer.attention.output.LayerNorm),
+            "fc_in": _dense(layer.intermediate.dense),
+            "fc_out": _dense(layer.output.dense),
+            "ln_mlp": _ln(layer.output.LayerNorm),
+        }
+    if cfg.pooler:
+        params["pooler"] = _dense(model.pooler.dense)
+    logger.info(f"converted HF bert: L{cfg.n_layer} d{cfg.n_embd}")
+    return cfg, params
+
+
+def convert_distilbert(model) -> Tuple[EncoderConfig, Any]:
+    """HF ``DistilBertModel`` → EncoderLM (no token types, no pooler)."""
+    if hasattr(model, "distilbert"):
+        model = model.distilbert
+    hf = model.config
+    cfg = distilbert_cfg(vocab_size=hf.vocab_size,
+                         max_seq_len=hf.max_position_embeddings,
+                         n_embd=hf.dim, n_layer=hf.n_layers, n_head=hf.n_heads,
+                         d_ff=hf.hidden_dim, ln_eps=1e-12)
+    emb = model.embeddings
+    params: Dict[str, Any] = {
+        "wte": _v(emb.word_embeddings.weight),
+        "wpe": _v(emb.position_embeddings.weight),
+        "ln_embed": _ln(emb.LayerNorm),
+    }
+    for i, layer in enumerate(model.transformer.layer):
+        params[f"layers_{i}"] = {
+            "q_proj": _dense(layer.attention.q_lin),
+            "k_proj": _dense(layer.attention.k_lin),
+            "v_proj": _dense(layer.attention.v_lin),
+            "o_proj": _dense(layer.attention.out_lin),
+            "ln_attn": _ln(layer.sa_layer_norm),
+            "fc_in": _dense(layer.ffn.lin1),
+            "fc_out": _dense(layer.ffn.lin2),
+            "ln_mlp": _ln(layer.output_layer_norm),
+        }
+    logger.info(f"converted HF distilbert: L{cfg.n_layer} d{cfg.n_embd}")
+    return cfg, params
+
+
+ENCODER_POLICIES = {"bert": convert_bert, "distilbert": convert_distilbert}
+
+
+def is_hf_encoder(model) -> bool:
+    return getattr(getattr(model, "config", None), "model_type", None) \
+        in ENCODER_POLICIES
+
+
+def convert_hf_encoder(model) -> Tuple[EncoderConfig, Any]:
+    model_type = model.config.model_type
+    return ENCODER_POLICIES[model_type](model)

@@ -167,6 +167,7 @@ class DeepSpeedEngine:
         self._host_steps = 0   # host mirror of state.global_step (see train_batch)
         self._grad_acc = None
         self._cached_grads = None
+        self._cached_loss = None
         self._last_metrics: Dict[str, Any] = {}
         self._fns: Dict[str, Any] = {}
 
@@ -406,15 +407,28 @@ class DeepSpeedEngine:
         host masters, the optimizer, and the loss scaler. ``self.state`` is None in this
         mode; step/scale bookkeeping lives on host."""
         from .zero.param_offload import ParamOffloadCoordinator
-        # no phantom config keys: features the streamed path does not wire fail loudly
+        # compression scheduler from ABSTRACT params (no resident tree exists)
+        self._compression = None
         if self._config.compression_config:
-            raise NotImplementedError(
-                "compression_training (QAT) is not wired into the offload_param "
-                "streamed step — disable one of the two")
-        if self._config.flops_profiler.enabled:
-            raise NotImplementedError(
-                "flops_profiler profiles the fused jitted step, which does not exist "
-                "under offload_param — disable one of the two")
+            from ..compression.compress import init_compression
+            abstract_params = jax.eval_shape(self.module.init_fn, rng)
+            sched = init_compression(abstract_params,
+                                     {"compression_training":
+                                      self._config.compression_config})
+            if sched.active:
+                self._compression = sched
+        # QAT composes via the coordinator's push transform: every streamed key is
+        # quantized on device right after its H2D push; grads w.r.t. the quantized
+        # values update the fp32 masters (straight-through estimator — same
+        # numerics as the resident engine's in-loss qat)
+        qat_fn = None
+        if self._compression is not None:
+            comp = self._compression
+
+            def qat_fn(key, tree, step):
+                # per-key mini-tree {key: subtree} reproduces the full tree's leaf
+                # paths, so the scheduler's path-matched plans apply identically
+                return comp.qat({key: tree}, jnp.int32(step))[key]
         oc = self._parse_optimizer_config()
         kind = "adagrad" if oc["name"] == "adagrad" else "adam"
         op_cfg = self._config.zero_config.offload_param
@@ -445,6 +459,7 @@ class DeepSpeedEngine:
             gradient_clipping=self._config.gradient_clipping or 0.0,
             fp16_enabled=self._config.fp16.enabled,
             loss_scaler=self.loss_scaler, scaler_state=scaler_state0,
+            qat_fn=qat_fn,
             nvme_path=nvme_path, nvme_param_path=nvme_param_path,
             aio_config={"thread_count": aio.thread_count,
                         "block_size": aio.block_size,
@@ -723,6 +738,9 @@ class DeepSpeedEngine:
         local = self._reshape_for_gas(batch)
         micros = [self._globalize(jax.tree_util.tree_map(lambda l: l[i], local))
                   for i in range(gas)]
+        fp_cfg = self._config.flops_profiler
+        if fp_cfg.enabled and self._host_steps + 1 == fp_cfg.profile_step:
+            self._run_flops_profiler_offload(micros[0])
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         lr = np.float32(self.get_lr_value())
@@ -754,6 +772,64 @@ class DeepSpeedEngine:
         new_params = self._offload_tier.step(grads, lr=float(lr), skip=skip)
         if new_params is not None:
             self.state = self.state._replace(params=new_params)
+
+    def _run_flops_profiler_offload(self, micro):
+        """Flops profile of the STREAMED step (offload_param): trace the composed
+        per-segment fwd+bwd of one microbatch over ABSTRACT parameters — no
+        full-model device materialisation, same jaxpr/XLA accounting as the fused
+        path's profile."""
+        from ..profiling.flops_profiler import FlopsProfiler
+        co = self._param_offload
+        profiler = FlopsProfiler(self._config.flops_profiler)
+
+        def abs_key(key):
+            leaves = [jax.ShapeDtypeStruct(s, self.compute_dtype)
+                      for s in co.key_shapes[key]]
+            return jax.tree_util.tree_unflatten(co.key_treedef[key], leaves)
+
+        params_t = tuple(tuple(abs_key(k) for k in seg.param_keys)
+                         for seg in co.segments)
+        G = len(co.segments)
+
+        def step_fn(seg_params, batch, rng):
+            xs = [None] * G
+            x = None
+            for g in range(G - 1):
+                srng = jax.random.fold_in(rng, g)
+                if co.segments[g].kind == "first":
+                    x = co._fwd(g)(seg_params[g], batch, srng)
+                else:
+                    xs[g] = x
+                    x = co._fwd(g)(seg_params[g], x, batch, srng)
+            xs[G - 1] = x
+            gout, loss = None, None
+            grads = []
+            for g in range(G - 1, -1, -1):
+                srng = jax.random.fold_in(rng, g)
+                seg = co.segments[g]
+                if seg.kind == "last":
+                    loss, gp, gout = co._bwd(g)(seg_params[g], xs[g], batch,
+                                                srng, jnp.float32(1.0))
+                elif seg.kind == "mid":
+                    gp, gout = co._bwd(g)(seg_params[g], xs[g], batch, srng,
+                                          gout)
+                else:
+                    gp = co._bwd(g)(seg_params[g], batch, srng, gout)
+                grads.append(gp)
+            return loss, grads
+
+        try:
+            profiler.profile_step(step_fn, params_t, micro,
+                                  jax.random.PRNGKey(0),
+                                  depth=self._config.flops_profiler.module_depth
+                                  if self._config.flops_profiler.module_depth >= 0
+                                  else 2)
+            sps = self.tput_timer.avg_samples_per_sec() or None
+            tput = (sps / self.train_batch_size()) if sps else None
+            profiler.print_model_profile(throughput_per_sec=tput)
+            self.flops_profiler = profiler
+        except Exception as e:
+            log_dist(f"flops profiler failed: {e}", ranks=[0])
 
     def _run_flops_profiler(self, gbatch):
         """One-shot train-step profile at ``flops_profiler.profile_step``
@@ -808,6 +884,7 @@ class DeepSpeedEngine:
                                            self.state.scaler.cur_scale,
                                            gb, rng, self.state.global_step, theta)
         self._cached_grads = grads
+        self._cached_loss = loss
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -821,11 +898,23 @@ class DeepSpeedEngine:
         forces it (stage >= 2) or at update time (psum via replicated spec).
         """
         assert self._cached_grads is not None, "backward() called before forward()"
+        if loss is not None and loss is not self._cached_loss \
+                and not getattr(self, "_loss_mismatch_warned", False):
+            # the cached grads differentiate the loss forward() computed — a
+            # transformed/recomputed loss here would be silently ignored (JAX
+            # cannot re-run autograd from a detached scalar, unlike torch)
+            logger.warning(
+                "backward(loss) received a different object than forward() "
+                "returned; gradients correspond to forward()'s loss — any "
+                "transformation applied in between does NOT reach the "
+                "gradients. Fold scaling/additions into the model's loss_fn.")
+            self._loss_mismatch_warned = True
         if self._grad_acc is None:
             self._grad_acc = self._cached_grads
         else:
             self._grad_acc = self._fns["acc_add"](self._grad_acc, self._cached_grads)
         self._cached_grads = None
+        self._cached_loss = None
         return loss
 
     def is_gradient_accumulation_boundary(self) -> bool:
@@ -1004,6 +1093,9 @@ class DeepSpeedEngine:
             self._host_steps = side.get("global_step", 0)
             self.micro_steps = side.get("micro_steps", 0)
             self._param_offload._skipped_steps = side.get("skipped_steps", 0)
+            # QAT schedule gating resumes where training left off (push_step is
+            # the coordinator's train-step mirror)
+            self._param_offload.push_step = self._host_steps
             if self.curriculum_scheduler is not None:
                 self.curriculum_scheduler.update_difficulty(self._host_steps)
             if self.progressive_layer_drop is not None:

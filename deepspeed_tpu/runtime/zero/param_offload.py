@@ -218,7 +218,7 @@ class ParamOffloadCoordinator:
                  nvme_path: Optional[str] = None,
                  nvme_param_path: Optional[str] = None,
                  aio_config: Optional[dict] = None,
-                 mesh=None):
+                 mesh=None, qat_fn=None):
         assert segments and segments[0].kind == "first" \
             and segments[-1].kind == "last", \
             "segments must run first → mid* → last"
@@ -230,6 +230,13 @@ class ParamOffloadCoordinator:
         self.loss_scaler = loss_scaler
         self.scaler_state = scaler_state
         self.mesh = mesh
+        # QAT under offload: ``qat_fn(key, subtree, step) -> subtree`` applied to
+        # every pushed key. Straight-through-estimator semantics come for free:
+        # the VJP differentiates w.r.t. the QUANTIZED pushed values and the host
+        # Adam applies those grads to the fp32 masters — exactly STE (the
+        # resident engine quantizes inside the loss fn for the same effect).
+        self.qat_fn = qat_fn
+        self.push_step = 0           # host mirror of global step for QAT gating
         self._skipped_steps = 0
         self._fwd_fns: Dict[int, Any] = {}
         self._bwd_fns: Dict[int, Any] = {}
@@ -472,6 +479,12 @@ class ParamOffloadCoordinator:
         return None
 
     def _push_key(self, key: str):
+        tree, nbytes = self._push_key_raw(key)
+        if self.qat_fn is not None:
+            tree = self.qat_fn(key, tree, self.push_step)
+        return tree, nbytes
+
+    def _push_key_raw(self, key: str):
         if self._partitioned:
             return self._push_key_partitioned(key)
         from .offload import cast_master_to
@@ -714,6 +727,7 @@ class ParamOffloadCoordinator:
         # ---- host update ---------------------------------------------------------
         metrics = self._host_update(lr, n_micro, scale)
         metrics["loss"] = float(np.mean([float(l) for l in losses]))
+        self.push_step += 1
         return metrics
 
     def _owned_flags(self) -> List[bool]:

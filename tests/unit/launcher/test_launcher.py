@@ -5,6 +5,7 @@ and filter parsing) and adds the integration lane VERDICT round-1 asked for: a 2
 launch on localhost running a real DP train step through the CLI.
 """
 
+import json
 import os
 import socket
 import subprocess
@@ -144,6 +145,38 @@ class TestLocalLaunch:
              "--master_port", str(_free_port()), str(bad)],
             timeout=120)
         assert proc.returncode == 3, proc.stderr
+
+    def test_elastic_bin_runs(self, tmp_path):
+        """bin/ds_tpu_elastic (reference bin/ds_elastic): prints the elastic
+        config and computed batch/world/micro results."""
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps({
+            "train_batch_size": 64,
+            "elasticity": {"enabled": True, "max_train_batch_size": 128,
+                           "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                           "max_gpus": 16, "version": 0.1}}))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bin", "ds_tpu_elastic"),
+             "-c", str(cfg), "-w", "4"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert "final_batch_size" in proc.stdout
+        assert "micro_batch_size .... 2" in proc.stdout
+
+    def test_ssh_bin_parses_hostfile(self, tmp_path):
+        """bin/ds_tpu_ssh: hostfile parsing + error contract (no ssh in CI)."""
+        proc = subprocess.run(
+            [os.path.join(REPO, "bin", "ds_tpu_ssh"), "-f", "/nonexistent",
+             "echo", "hi"], capture_output=True, text=True, timeout=30)
+        assert proc.returncode == 1 and "not found" in proc.stderr
+        hf = tmp_path / "hostfile"
+        hf.write_text("# comment\n\n")
+        proc = subprocess.run(
+            [os.path.join(REPO, "bin", "ds_tpu_ssh"), "-f", str(hf), "true"],
+            capture_output=True, text=True, timeout=30)
+        assert proc.returncode == 1 and "no hosts" in proc.stderr
 
     def test_env_report_runs(self):
         env = dict(os.environ)
