@@ -231,6 +231,47 @@ BLOCK_TP_COL = ("q_attn", "k_attn", "v_attn", "c_fc")
 BLOCK_TP_ROW = ("c_proj", "mlp_c_proj")
 
 
+def block_sp_apply(cfg: GPT2Config, sp: int, axis: str):
+    """Sequence-parallel Block forward for use INSIDE a ``shard_map`` whose manual
+    axes include ``axis`` (pipe×seq: context parallelism inside 1F1B pipeline
+    stages — beyond the reference, whose SP story is absent).
+
+    Activations arrive SEQUENCE-SHARDED ``(b, t/S, d)``; parameters are the full
+    replicated Block tree (dense/LN work is per-token, so local chunks need no
+    collectives) and attention all-gathers K/V over the seq axis
+    (:func:`~...ops.attention.ring.allgather_attention_local` — grouped
+    collectives, NOT the ppermute ring, because pipeline stage activity is
+    staggered; see that function's docstring). Exactly equal to the replicated
+    ``Block`` (dropout off) at any seq degree.
+
+    Returns ``fn(params, x_local, rng) -> y_local``.
+    """
+    assert cfg.split_qkv, "seq-parallel Block needs split_qkv=True (see GPT2Config)"
+    assert cfg.dropout == 0.0, "SP stage_fn does not implement attention dropout"
+    dt = cfg.dtype
+
+    def dense(p, x):
+        return x @ p["kernel"].astype(dt) + p["bias"].astype(dt)
+
+    def apply(p, x, rng=None):
+        from ..ops.attention.ring import allgather_attention_local
+        b, tl, _ = x.shape
+        h = _manual_layer_norm(p["ln_1"], x).astype(dt)
+        q = dense(p["q_attn"], h).reshape(b, tl, cfg.n_head, cfg.head_dim)
+        k = dense(p["k_attn"], h).reshape(b, tl, cfg.n_head, cfg.head_dim)
+        v = dense(p["v_attn"], h).reshape(b, tl, cfg.n_head, cfg.head_dim)
+        o = allgather_attention_local(q, k, v, causal=True, axis_name=axis)
+        o = o.reshape(b, tl, cfg.n_embd)
+        o = dense(p["c_proj"], o)
+        x = x + o
+        h = _manual_layer_norm(p["ln_2"], x).astype(dt)
+        h = nn.gelu(dense(p["c_fc"], h), approximate=True)
+        h = dense(p["mlp_c_proj"], h)
+        return x + h
+
+    return apply
+
+
 class GPT2(nn.Module):
     config: GPT2Config
 
@@ -281,6 +322,28 @@ def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
     nll = (logz - gold) * mask
     return nll.sum() / jnp.maximum(mask.sum(), 1)
+
+
+def cross_entropy_loss_sp(logits, labels, axis_name: str,
+                          ignore_index: int = -100):
+    """Sequence-parallel CE: this shard's (sum, valid-count) contributions are
+    psum'd over ``axis_name`` before the ratio, so unequal masked-token counts
+    per shard (e.g. the final -100 living on the last shard) stay exact. For use
+    INSIDE a shard_map manual over the seq axis (the 1F1B sp tail).
+
+    The sum rides the ``g`` conjugate op (psum forward, IDENTITY backward): under
+    ``check_vma=False`` a raw psum transposes to another psum, which would scale
+    every upstream cotangent by the seq degree (the same trap the Megatron f/g
+    ops exist for)."""
+    _, g_op = _tp_conjugate_ops(axis_name)
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = ((logz - gold) * mask).sum()
+    total = g_op(nll)
+    count = jax.lax.psum(mask.sum(), axis_name)   # integer: no cotangent path
+    return total / jnp.maximum(count, 1)
 
 
 def gpt2_model(config: GPT2Config, sample_seq_len: Optional[int] = None,

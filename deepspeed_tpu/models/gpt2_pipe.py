@@ -12,8 +12,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..runtime.pipe.module import FlaxPipeLayer, LayerSpec, PipelineModule, TiedLayerSpec
-from .gpt2 import (BLOCK_TP_COL, BLOCK_TP_ROW, Block, GPT2Config, block_tp_apply,
-                   cross_entropy_loss)
+from .gpt2 import (BLOCK_TP_COL, BLOCK_TP_ROW, Block, GPT2Config, block_sp_apply,
+                   block_tp_apply, cross_entropy_loss, cross_entropy_loss_sp)
 
 
 class GPT2EmbedPipe(nn.Module):
@@ -45,12 +45,14 @@ def _embed_layer(cfg):
 
 
 def _block_layer(cfg):
-    tp_factory = None
+    tp_factory = sp_factory = None
     if cfg.split_qkv:
         tp_factory = lambda tp, axis: block_tp_apply(cfg, tp, axis)
+        sp_factory = lambda sp, axis: block_sp_apply(cfg, sp, axis)
     return FlaxPipeLayer(Block(cfg), deterministic_kwarg=True,
                          tp_apply_factory=tp_factory,
-                         tp_col=BLOCK_TP_COL, tp_row=BLOCK_TP_ROW)
+                         tp_col=BLOCK_TP_COL, tp_row=BLOCK_TP_ROW,
+                         sp_apply_factory=sp_factory)
 
 
 def _norm_layer(cfg):
@@ -79,6 +81,7 @@ def gpt2_pipeline_module(config: GPT2Config, num_stages: int,
         layers=layers,
         num_stages=num_stages,
         loss_fn=cross_entropy_loss,
+        sp_loss_fn=cross_entropy_loss_sp,
         sample_input=sample,
         partition_method=partition_method,
         activation_checkpoint_interval=activation_checkpoint_interval,

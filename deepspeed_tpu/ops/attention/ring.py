@@ -45,6 +45,74 @@ def _chunk_attn(q, k, v, rows0, cols0, causal, scale):
     return acc, m, l
 
 
+def ring_attention_local(q_l, k_l, v_l, causal: bool = True,
+                         softmax_scale: Optional[float] = None,
+                         axis_name: str = AXIS_SEQ,
+                         seq_size: Optional[int] = None) -> jnp.ndarray:
+    """Per-shard ring attention for callers ALREADY INSIDE a ``shard_map`` whose
+    manual axes include ``axis_name`` (e.g. the 1F1B pipeline's seq-parallel body
+    stage_fn, where :func:`ring_attention`'s own shard_map would illegally nest).
+
+    q_l/k_l/v_l: this shard's ``(b, t/S, h, d)`` chunks; K/V rotate around the
+    ring via ``ppermute`` with online-softmax (LSE) merging."""
+    b, tl, h, d = q_l.shape
+    S = seq_size if seq_size is not None else jax.lax.psum(1, axis_name)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    perm = [(r, (r + 1) % S) for r in range(S)]
+    s_idx = jax.lax.axis_index(axis_name)
+    rows0 = s_idx * tl
+
+    def step(carry, i):
+        m_run, l_run, acc, k_c, v_c = carry
+        owner = (s_idx - i) % S       # which global chunk this k/v is
+        cols0 = owner * tl
+        acc_c, m_c, l_c = _chunk_attn(q_l, k_c, v_c, rows0, cols0, causal, scale)
+        m_new = jnp.maximum(m_run, m_c)
+        a_run = jnp.exp(m_run - m_new)
+        a_c = jnp.exp(m_c - m_new)
+        acc = acc * a_run[..., None] + acc_c * a_c[..., None]
+        l_new = l_run * a_run + l_c * a_c
+        # rotate k/v to the next device (backward runs the reverse ring)
+        k_n = jax.lax.ppermute(k_c, axis_name, perm)
+        v_n = jax.lax.ppermute(v_c, axis_name, perm)
+        return (m_new, l_new, acc, k_n, v_n), None
+
+    m0 = jnp.full((b, h, tl), NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    acc0 = jnp.zeros((b, h, tl, d), jnp.float32)
+    (m_f, l_f, acc_f, _, _), _ = jax.lax.scan(
+        jax.checkpoint(step), (m0, l0, acc0, k_l, v_l), jnp.arange(S))
+    l_safe = jnp.where(l_f > 0, l_f, 1.0)
+    o = (acc_f / l_safe[..., None]).transpose(0, 2, 1, 3)  # (b, tl, h, d)
+    return o.astype(q_l.dtype)
+
+
+def allgather_attention_local(q_l, k_l, v_l, causal: bool = True,
+                              softmax_scale: Optional[float] = None,
+                              axis_name: str = AXIS_SEQ) -> jnp.ndarray:
+    """Sequence-parallel attention via GROUPED all-gather of K/V — for manual
+    regions where the ppermute ring cannot run.
+
+    Inside the 1F1B pipeline, stage activity is staggered: at any tick only some
+    pipe rows execute the attention. A ``ppermute`` (collective-permute) encodes
+    every device's source→target pair in ONE instruction, so executing it under a
+    pipe-non-uniform ``lax.cond`` is undefined (observed: XLA CPU thunk crash).
+    GROUPED collectives (all-gather / psum with per-pipe-row replica groups)
+    execute all-or-nothing per row and are safe there. The trade: K/V materialise
+    fully (O(t)) inside attention — activations stay sequence-sharded, so stage
+    memory and cross-stage traffic keep the /S win; K/V HBM is transient.
+    """
+    b, tl, h, d = q_l.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    k = jax.lax.all_gather(k_l, axis_name, axis=1, tiled=True)   # (b, t, h, d)
+    v = jax.lax.all_gather(v_l, axis_name, axis=1, tiled=True)
+    s_idx = jax.lax.axis_index(axis_name)
+    acc, m, l = _chunk_attn(q_l, k, v, s_idx * tl, 0, causal, scale)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o = (acc / l_safe[..., None]).transpose(0, 2, 1, 3)          # (b, tl, h, d)
+    return o.astype(q_l.dtype)
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    causal: bool = True, mask: Optional[jnp.ndarray] = None,
                    softmax_scale: Optional[float] = None,
@@ -65,41 +133,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     b, t, h, d = q.shape
     S = mesh.size(axis_name)
     assert t % S == 0, f"seq len {t} must divide the seq axis {S}"
-    tl = t // S
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
-    perm = [(r, (r + 1) % S) for r in range(S)]
-
-    def ring_fn(q_l, k_l, v_l):
-        # local chunks (b, tl, h, d)
-        s_idx = jax.lax.axis_index(axis_name)
-        rows0 = s_idx * tl
-
-        def step(carry, i):
-            m_run, l_run, acc, k_c, v_c = carry
-            owner = (s_idx - i) % S       # which global chunk this k/v is
-            cols0 = owner * tl
-            acc_c, m_c, l_c = _chunk_attn(q_l, k_c, v_c, rows0, cols0, causal, scale)
-            m_new = jnp.maximum(m_run, m_c)
-            a_run = jnp.exp(m_run - m_new)
-            a_c = jnp.exp(m_c - m_new)
-            acc = acc * a_run[..., None] + acc_c * a_c[..., None]
-            l_new = l_run * a_run + l_c * a_c
-            # rotate k/v to the next device (backward runs the reverse ring)
-            k_n = jax.lax.ppermute(k_c, axis_name, perm)
-            v_n = jax.lax.ppermute(v_c, axis_name, perm)
-            return (m_new, l_new, acc, k_n, v_n), None
-
-        m0 = jnp.full((b, h, tl), NEG_BIG, jnp.float32)
-        l0 = jnp.zeros((b, h, tl), jnp.float32)
-        acc0 = jnp.zeros((b, h, tl, d), jnp.float32)
-        (m_f, l_f, acc_f, _, _), _ = jax.lax.scan(
-            jax.checkpoint(step), (m0, l0, acc0, k_l, v_l), jnp.arange(S))
-        l_safe = jnp.where(l_f > 0, l_f, 1.0)
-        o = (acc_f / l_safe[..., None]).transpose(0, 2, 1, 3)  # (b, tl, h, d)
-        return o.astype(q_l.dtype)
 
     mapped = jax.shard_map(
-        ring_fn,
+        lambda q_l, k_l, v_l: ring_attention_local(
+            q_l, k_l, v_l, causal=causal, softmax_scale=scale,
+            axis_name=axis_name, seq_size=S),
         mesh=mesh.mesh,
         axis_names={axis_name},
         in_specs=(P(None, axis_name, None, None),) * 3,

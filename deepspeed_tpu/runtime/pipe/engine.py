@@ -48,16 +48,23 @@ class PipelineEngine(DeepSpeedEngine):
         # {pipe, tensor} and body weights shard physically (Megatron col/row; the
         # reference's 3D topology, pipe/topology.py:243). Bodies without a tp
         # forward replicate over the tensor axis as before.
-        from ...parallel.mesh import AXIS_TENSOR
+        from ...parallel.mesh import AXIS_SEQ, AXIS_TENSOR
         tp_axis = None
         body_layer = model._layers[model.body_start]
         if (getattr(cfg.mesh, "tensor", 1) or 1) > 1 \
                 and getattr(body_layer, "tp_apply_factory", None) is not None:
             tp_axis = AXIS_TENSOR
+        # pipe×seq: a seq axis + an sp-capable body runs the 1F1B body on
+        # sequence-sharded chunks with ring attention (sp_apply_factory)
+        sp_axis = None
+        if (getattr(cfg.mesh, "seq", 1) or 1) > 1 \
+                and getattr(body_layer, "sp_apply_factory", None) is not None:
+            sp_axis = AXIS_SEQ
         model_obj = model.to_model(mesh_spec=None, name=f"pipe{model.num_stages}",
                                    tp_axis=tp_axis,
                                    tp_size=getattr(cfg.mesh, "tensor", None),
-                                   ep_size=getattr(cfg.mesh, "expert", None))
+                                   ep_size=getattr(cfg.mesh, "expert", None),
+                                   sp_axis=sp_axis)
         super().__init__(args=args, model=model_obj, optimizer=optimizer,
                          model_parameters=model_parameters, training_data=training_data,
                          lr_scheduler=lr_scheduler, mpu=mpu, collate_fn=collate_fn,

@@ -83,12 +83,16 @@ class FlaxPipeLayer(PipeLayer):
     """
 
     def __init__(self, module, deterministic_kwarg: bool = False,
-                 tp_apply_factory=None, tp_col: tuple = (), tp_row: tuple = ()):
+                 tp_apply_factory=None, tp_col: tuple = (), tp_row: tuple = (),
+                 sp_apply_factory=None):
         self.module = module
         self.deterministic_kwarg = deterministic_kwarg
         self.tp_apply_factory = tp_apply_factory
         self.tp_col = tuple(tp_col)
         self.tp_row = tuple(tp_row)
+        # seq-parallel forward: sp_apply_factory(sp, axis) returns a ring-local
+        # layer fn consuming SEQUENCE-SHARDED activations (pipe×seq 1F1B bodies)
+        self.sp_apply_factory = sp_apply_factory
 
     def _kwargs(self, rng):
         return {"deterministic": rng is None} if self.deterministic_kwarg else {}
@@ -229,6 +233,7 @@ class PipelineModule:
                  partition_method: str = "uniform",
                  activation_checkpoint_interval: int = 0,
                  aux_loss_coef: float = 0.0,
+                 sp_loss_fn=None,
                  seed: int = 1234):
         if num_stages is None and topology is None:
             raise RuntimeError("must provide num_stages or topology")
@@ -241,6 +246,9 @@ class PipelineModule:
         self.activation_checkpoint_interval = activation_checkpoint_interval
         # weight of body layers' auxiliary losses (MoE load balancing) in the total
         self.aux_loss_coef = float(aux_loss_coef)
+        # sp_loss_fn(out_local, lab_local, axis_name): sequence-sharded tail loss
+        # (psums its sum/count over the seq axis) — required for sp 1F1B
+        self.sp_loss_fn = sp_loss_fn
         self.seed = seed
         assert sample_input is not None, \
             "PipelineModule needs sample_input (abstract is fine) to trace layer shapes"
@@ -535,7 +543,8 @@ class PipelineModule:
     # ------------------------------------------------------------------ 1F1B
     def make_1f1b_loss_fn(self, mesh_spec: Optional[MeshSpec] = None,
                           tp_axis: Optional[str] = None,
-                          aux_loss_coef: Optional[float] = None):
+                          aux_loss_coef: Optional[float] = None,
+                          sp_axis: Optional[str] = None):
         """Interleaved 1F1B with manual in-loop backward — O(stages) activation memory.
 
         Reference semantics: ``runtime/pipe/engine.py:295`` executing
@@ -592,16 +601,41 @@ class PipelineModule:
             view = {"pre": pre_p, "post": {}, "tied": tied_p}
             return self._segment_apply(view, x, mrng, 0, self.body_start)
 
-        def tail_loss(post_p, tied_p, y, lab, mrng):
+        def tail_loss(post_p, tied_p, y, lab, mrng, sp=1):
             view = {"pre": {}, "post": post_p, "tied": tied_p}
             out = self._segment_apply(view, y, mrng, self.body_end, n_layers)
+            if sp > 1:
+                # sequence-sharded tail: per-shard loss contributions reduce to
+                # the global mean via psum inside sp_loss_fn (sum/count over the
+                # seq axis — unequal valid-token counts per shard stay exact)
+                assert self.sp_loss_fn is not None, \
+                    "seq-parallel 1F1B needs PipelineModule.sp_loss_fn"
+                return self.sp_loss_fn(out, lab, sp_axis)
             if self.loss_fn is not None:
                 return self.loss_fn(out, lab)
             return out if out.ndim == 0 else jnp.mean(out)
 
         tp_fns = {}   # tp degree -> manual-collective layer forward (built lazily)
+        sp_fns = {}   # sp degree -> ring-local layer forward (built lazily)
 
-        def _layer_apply(tp):
+        def _layer_apply(tp, sp=1):
+            if sp > 1 and sp_axis is not None:
+                # pipe×seq: activations are sequence-sharded inside the stage;
+                # attention all-gathers K/V over the seq axis (GROUPED collective
+                # — a ppermute ring under the pipe-staggered conds is undefined,
+                # see ops/attention/ring.py:allgather_attention_local)
+                assert tp <= 1 and not body_aux, \
+                    "seq parallelism inside 1F1B does not compose with in-stage " \
+                    "TP or aux-loss (MoE) bodies yet"
+                if sp not in sp_fns:
+                    factory = getattr(body_layer, "sp_apply_factory", None)
+                    assert factory is not None, \
+                        ("sequence parallelism inside the 1F1B pipeline needs a "
+                         "body layer with sp_apply_factory (e.g. gpt2_pipe "
+                         "blocks with GPT2Config(split_qkv=True))")
+                    sp_fns[sp] = factory(sp, sp_axis)
+                fn = sp_fns[sp]
+                return lambda p, x, r: (fn(p, x, r), jnp.float32(0.0))
             if tp <= 1 or tp_axis is None:
                 if body_aux:
                     return lambda p, x, r: body_layer.apply_with_aux(p, x, r)
@@ -621,8 +655,8 @@ class PipelineModule:
             fn = tp_fns[tp]
             return lambda p, x, r: (fn(p, x, r), jnp.float32(0.0))
 
-        def make_stage_fn(tp):
-            layer_fn = _layer_apply(tp)
+        def make_stage_fn(tp, sp=1):
+            layer_fn = _layer_apply(tp, sp)
 
             def stage_fn(stage_params, x, srng, use_rng):
                 def one(carry, xs_):
@@ -653,7 +687,8 @@ class PipelineModule:
         def run_1f1b(params, batch, rng, use_rng: bool):
             mesh = mesh_spec or _require_global_mesh()
             tp = mesh.size(tp_axis) if tp_axis else 1
-            stage_fn = make_stage_fn(tp)
+            sp = mesh.size(sp_axis) if sp_axis else 1
+            stage_fn = make_stage_fn(tp, sp)
             inputs, labels = split_batch(batch)
             M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
             n_ticks = 2 * (M + S) - 3
@@ -668,11 +703,36 @@ class PipelineModule:
                 x0_shape = jax.eval_shape(
                     pre_apply, _abstract(pre_p), _abstract(tied_p),
                     _abstract(idx(inputs_, 0)), rng_pre)
-                stash0 = jnp.zeros((S,) + tuple(x0_shape.shape), x0_shape.dtype)
+                # pipe×seq: the PRE segment runs on FULL sequences (embeddings
+                # are cheap and position-offset-free); the BODY and TAIL carry
+                # t/sp local chunks (tail loss reduces via sp_loss_fn's psum) —
+                # stash, recv buffers and cross-stage permutes all shrink by sp,
+                # and attention all-gathers K/V over the seq axis
+                if sp > 1:
+                    t_full = x0_shape.shape[1]
+                    assert t_full % sp == 0, (t_full, sp)
+                    tl_sp = t_full // sp
+                    s_sp = jax.lax.axis_index(sp_axis)
+                    body_shape = (x0_shape.shape[0], tl_sp) + \
+                        tuple(x0_shape.shape[2:])
+
+                    def to_local(x_full):
+                        return jax.lax.dynamic_slice_in_dim(
+                            x_full, s_sp * tl_sp, tl_sp, axis=1)
+
+                    def to_full_cot(dx_local):
+                        zeros = jnp.zeros(tuple(x0_shape.shape),
+                                          dx_local.dtype)
+                        return jax.lax.dynamic_update_slice_in_dim(
+                            zeros, dx_local, s_sp * tl_sp, axis=1)
+                else:
+                    body_shape = tuple(x0_shape.shape)
+                    to_local = to_full_cot = lambda x: x
+                stash0 = jnp.zeros((S,) + body_shape, x0_shape.dtype)
 
                 carry0 = dict(
-                    recv_f=jnp.zeros(x0_shape.shape, x0_shape.dtype),
-                    recv_b=jnp.zeros(x0_shape.shape, x0_shape.dtype),
+                    recv_f=jnp.zeros(body_shape, x0_shape.dtype),
+                    recv_b=jnp.zeros(body_shape, x0_shape.dtype),
                     stash=stash0,
                     loss=jnp.float32(0.0),
                     dbody=f32_zeros(body_p),
@@ -697,7 +757,7 @@ class PipelineModule:
                         x0 = pre_apply(
                             pre_p, tied_p, idx(inputs_, mf),
                             jax.random.fold_in(rng_pre, mf) if use_rng else None)
-                        x_in = jnp.where(s == 0, x0, recv_f)
+                        x_in = jnp.where(s == 0, to_local(x0), recv_f)
                         y, aux = stage_fn(
                             body_p, x_in,
                             jax.random.fold_in(jax.random.fold_in(rng_body, mf), s),
@@ -713,10 +773,15 @@ class PipelineModule:
 
                     def tail_block(y_):
                         lab_m = idx(labels_, mf) if labels_ is not None else None
+                        if sp > 1 and lab_m is not None:
+                            lab_m = jax.tree_util.tree_map(
+                                lambda a: jax.lax.dynamic_slice_in_dim(
+                                    a, s_sp * tl_sp, tl_sp, axis=1), lab_m)
                         loss_m, tail_vjp = jax.vjp(
                             lambda po, ti, yy: tail_loss(
                                 po, ti, yy, lab_m,
-                                jax.random.fold_in(rng_tail, mf) if use_rng else None),
+                                jax.random.fold_in(rng_tail, mf) if use_rng
+                                else None, sp=sp),
                             post_p, tied_p, y_)
                         dpost_m, dtied_m, dy_m = tail_vjp(jnp.float32(1.0))
                         return (loss_m.astype(jnp.float32), f32_cast(dpost_m),
@@ -761,12 +826,14 @@ class PipelineModule:
 
                     def pre_block(dx_):
                         # stage 0 re-plays the pre segment to push dx into embeddings/tied
+                        # (sp: scatter the LOCAL chunk's cotangent into the full-
+                        # sequence zeros — other chunks contribute via the sp psum)
                         _, pvjp = jax.vjp(
                             lambda pr, ti: pre_apply(
                                 pr, ti, idx(inputs_, mb),
                                 jax.random.fold_in(rng_pre, mb) if use_rng else None),
                             pre_p, tied_p)
-                        dpre_m, dtied_m = pvjp(dx_)
+                        dpre_m, dtied_m = pvjp(to_full_cot(dx_))
                         return f32_cast(dpre_m), f32_cast(dtied_m)
 
                     def pre_skip(dx_):
@@ -791,10 +858,15 @@ class PipelineModule:
                 loss = jax.lax.psum(out["loss"] * inv_m, AXIS_PIPE)
                 scale_tree = lambda tr: jax.tree_util.tree_map(
                     lambda g: g * inv_m, tr)
-                dpre = jax.lax.psum(scale_tree(out["dpre"]), AXIS_PIPE)
-                dpost = jax.lax.psum(scale_tree(out["dpost"]), AXIS_PIPE)
-                dtied = jax.lax.psum(scale_tree(out["dtied"]), AXIS_PIPE)
+                # sp: pre/post/tied/body grads are per-shard partials (each seq
+                # shard differentiated only its tokens' contribution) — sum them
+                repl_axes = (AXIS_PIPE, sp_axis) if sp > 1 else AXIS_PIPE
+                dpre = jax.lax.psum(scale_tree(out["dpre"]), repl_axes)
+                dpost = jax.lax.psum(scale_tree(out["dpost"]), repl_axes)
+                dtied = jax.lax.psum(scale_tree(out["dtied"]), repl_axes)
                 dbody = scale_tree(out["dbody"])
+                if sp > 1:
+                    dbody = jax.lax.psum(dbody, sp_axis)
                 return loss, dbody, dpre, dpost, dtied
 
             lab_spec = None if labels is None else P()
@@ -804,6 +876,8 @@ class PipelineModule:
             else:
                 body_specs = P(AXIS_PIPE)
                 manual_axes = {AXIS_PIPE}
+            if sp > 1:
+                manual_axes = manual_axes | {sp_axis}
             mapped = jax.shard_map(
                 run,
                 mesh=mesh.mesh,
@@ -843,7 +917,7 @@ class PipelineModule:
     def to_model(self, mesh_spec: Optional[MeshSpec] = None, name: str = "pipeline",
                  remat: Optional[bool] = None, schedule: str = "1f1b",
                  tp_axis: Optional[str] = None, tp_size: Optional[int] = None,
-                 ep_size: Optional[int] = None):
+                 ep_size: Optional[int] = None, sp_axis: Optional[str] = None):
         """Bundle into the engine's :class:`Model` contract. ``loss_fn`` consumes microbatched
         batches ``(inputs, labels)`` with leading dim M and returns mean loss; ``rng=None``
         runs a deterministic (dropout-off) pass.
@@ -863,7 +937,8 @@ class PipelineModule:
         body_has_aux = bool(getattr(self._layers[self.body_start], "has_aux",
                                     False))
         pipe_loss_1f1b = (self.make_1f1b_loss_fn(mesh_spec, tp_axis=tp_axis,
-                                                 aux_loss_coef=self.aux_loss_coef)
+                                                 aux_loss_coef=self.aux_loss_coef,
+                                                 sp_axis=sp_axis)
                           if schedule == "1f1b" and self.num_stages > 1 else None)
         if body_has_aux and pipe_loss_1f1b is None:
             raise NotImplementedError(
