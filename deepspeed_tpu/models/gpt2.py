@@ -160,7 +160,8 @@ def _tp_conjugate_ops(axis: str):
     return f, g
 
 
-def block_tp_apply(cfg: GPT2Config, tp: int, axis: str):
+def block_tp_apply(cfg: GPT2Config, tp: int, axis: str,
+                   sp_axis: Optional[str] = None):
     """Megatron-style manual-collective Block forward for use INSIDE a ``shard_map``
     whose manual axes include ``axis`` (reference 3D parallelism: TP inside pipeline
     stages, ``runtime/pipe/topology.py:243``; column/row classification as in
@@ -171,6 +172,11 @@ def block_tp_apply(cfg: GPT2Config, tp: int, axis: str):
     f/g conjugate pair brackets each col→row sandwich — the two collectives per
     block that Megatron inserts. Exactly equal to the replicated ``Block``
     (``split_qkv=True``, dropout off) at any tp degree.
+
+    With ``sp_axis`` the activations additionally arrive SEQUENCE-SHARDED
+    (pipe×tensor×seq 4D): dense/LN math is per-token so only the attention
+    changes — local heads attend over K/V all-gathered along the seq axis
+    (grouped collectives; see ``allgather_attention_local``).
 
     Returns ``fn(params_local, x, rng) -> y``.
     """
@@ -193,6 +199,10 @@ def block_tp_apply(cfg: GPT2Config, tp: int, axis: str):
             "shard_map — use 'auto', 'xla', or 'flash' for TP pipeline bodies")
 
     def attention(q, k, v):
+        if sp_axis is not None:
+            from ..ops.attention.ring import allgather_attention_local
+            return allgather_attention_local(q, k, v, causal=True,
+                                             axis_name=sp_axis)
         from ..ops.transformer.attention import FLASH_MIN_SEQ, xla_attention
         t = q.shape[1]
         use_flash = (impl == "flash" or

@@ -47,7 +47,7 @@ def _embed_layer(cfg):
 def _block_layer(cfg):
     tp_factory = sp_factory = None
     if cfg.split_qkv:
-        tp_factory = lambda tp, axis: block_tp_apply(cfg, tp, axis)
+        tp_factory = lambda tp, axis, **kw: block_tp_apply(cfg, tp, axis, **kw)
         sp_factory = lambda sp, axis: block_sp_apply(cfg, sp, axis)
     return FlaxPipeLayer(Block(cfg), deterministic_kwarg=True,
                          tp_apply_factory=tp_factory,

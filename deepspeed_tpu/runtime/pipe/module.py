@@ -624,17 +624,35 @@ class PipelineModule:
                 # attention all-gathers K/V over the seq axis (GROUPED collective
                 # — a ppermute ring under the pipe-staggered conds is undefined,
                 # see ops/attention/ring.py:allgather_attention_local)
-                assert tp <= 1 and not body_aux, \
-                    "seq parallelism inside 1F1B does not compose with in-stage " \
-                    "TP or aux-loss (MoE) bodies yet"
-                if sp not in sp_fns:
-                    factory = getattr(body_layer, "sp_apply_factory", None)
-                    assert factory is not None, \
-                        ("sequence parallelism inside the 1F1B pipeline needs a "
-                         "body layer with sp_apply_factory (e.g. gpt2_pipe "
-                         "blocks with GPT2Config(split_qkv=True))")
-                    sp_fns[sp] = factory(sp, sp_axis)
-                fn = sp_fns[sp]
+                assert not body_aux, \
+                    "seq parallelism inside 1F1B does not compose with " \
+                    "aux-loss (MoE) bodies yet"
+                key = (tp, sp)
+                if key not in sp_fns:
+                    if tp > 1 and tp_axis is not None:
+                        # pipe×tensor×seq 4D: the TP block with seq-sharded
+                        # activations — dense/LN are per-token, only attention
+                        # changes (local heads over seq-gathered K/V)
+                        import inspect
+                        factory = getattr(body_layer, "tp_apply_factory", None)
+                        assert factory is not None, \
+                            "pipe×tensor×seq needs a body tp_apply_factory"
+                        sig = inspect.signature(factory)
+                        assert "sp_axis" in sig.parameters or any(
+                            p.kind == inspect.Parameter.VAR_KEYWORD
+                            for p in sig.parameters.values()), \
+                            ("the body's tp_apply_factory does not accept "
+                             "sp_axis — pipe×tensor×seq needs one that does "
+                             "(e.g. gpt2 blocks, models/gpt2.py:block_tp_apply)")
+                        sp_fns[key] = factory(tp, tp_axis, sp_axis=sp_axis)
+                    else:
+                        factory = getattr(body_layer, "sp_apply_factory", None)
+                        assert factory is not None, \
+                            ("sequence parallelism inside the 1F1B pipeline "
+                             "needs a body layer with sp_apply_factory (e.g. "
+                             "gpt2_pipe blocks with GPT2Config(split_qkv=True))")
+                        sp_fns[key] = factory(sp, sp_axis)
+                fn = sp_fns[key]
                 return lambda p, x, r: (fn(p, x, r), jnp.float32(0.0))
             if tp <= 1 or tp_axis is None:
                 if body_aux:

@@ -61,6 +61,40 @@ class TestSP1F1B:
                 np.asarray(flat_sp[path]), np.asarray(g_ref), rtol=2e-4,
                 atol=2e-5, err_msg=jax.tree_util.keystr(path))
 
+    def test_4d_pipe_tensor_seq_grads_match(self, eight_devices):
+        """pipe=2 × tensor=2 × seq=2 (4D): in-stage Megatron TP with sequence-
+        sharded activations — loss AND grads equal to the replicated pipe run;
+        body weights stay physically TP-sharded."""
+        from jax.sharding import NamedSharding
+        cfg = GPT2Config(**TINY)
+        mod = gpt2_pipeline_module(cfg, num_stages=2, sample_seq_len=32)
+        params = mod.init_fn(jax.random.PRNGKey(0))
+        batch = _batch()
+        rng = jax.random.PRNGKey(7)
+
+        mesh_ref = MeshSpec({"pipe": 2}, eight_devices[:2])
+        loss_ref, grads_ref = jax.jit(jax.value_and_grad(
+            mod.make_1f1b_loss_fn(mesh_ref)))(params, batch, rng)
+        grads_ref = jax.tree_util.tree_map(np.asarray, grads_ref)
+
+        mesh4 = MeshSpec({"pipe": 2, "tensor": 2, "seq": 2}, eight_devices)
+        specs = mod.param_specs(tp_axis="tensor", tp_size=2)
+        placed = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh4.mesh, s)),
+            params, specs)
+        assert "tensor" in tuple(
+            placed["body"]["q_attn"]["kernel"].sharding.spec)
+        fn4 = mod.make_1f1b_loss_fn(mesh4, tp_axis="tensor", sp_axis="seq")
+        loss4, grads4 = jax.jit(jax.value_and_grad(fn4))(placed, batch, rng)
+        grads4 = jax.tree_util.tree_map(np.asarray, grads4)
+
+        np.testing.assert_allclose(float(loss4), float(loss_ref), rtol=1e-5)
+        flat4 = dict(jax.tree_util.tree_leaves_with_path(grads4))
+        for path, g_ref in jax.tree_util.tree_leaves_with_path(grads_ref):
+            np.testing.assert_allclose(
+                flat4[path], g_ref, rtol=2e-4, atol=2e-5,
+                err_msg=jax.tree_util.keystr(path))
+
     def test_engine_pipe_seq_data(self, eight_devices):
         """Full composition: pipe=2 × seq=2 × data=2 through the engine; loss
         decreases training on one batch."""
