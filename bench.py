@@ -78,10 +78,16 @@ def bench_train():
     # micro=24 + dots remat (save matmul outputs, recompute elementwise) + length-
     # dispatched attention measured fastest on v5e: 67.8k tok/s vs 62.5k for the
     # round-1 micro=32 full-remat flash config
+    # BENCH_VOCAB_CHUNK>0 switches the loss to the chunked-vocab CE (no (b, t, V)
+    # logits buffer) — required for the long-sequence shapes (seq 32k+)
     cfg = GPT2Config(vocab_size=50304,  # padded to 128 multiple for MXU tiling
                      n_positions=seq, n_embd=768, n_layer=12, n_head=12,
-                     dropout=0.0, remat=True, remat_policy="dots",
-                     scan_layers=True)
+                     dropout=0.0, remat=True,
+                     # "dots" (save matmul outputs) is fastest at the canonical
+                     # shape; extreme sequence lengths need "full" remat
+                     remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots"),
+                     scan_layers=True,
+                     vocab_chunk=int(os.environ.get("BENCH_VOCAB_CHUNK", 0)))
     model = gpt2_model(cfg, sample_seq_len=seq)
     config = {
         "train_batch_size": micro * n_chips,

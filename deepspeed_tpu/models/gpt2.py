@@ -46,6 +46,11 @@ class GPT2Config:
     # (d, 3d) kernel sharded contiguously would mix q/k/v columns per shard, making the
     # model's meaning depend on tp — a silent checkpoint-portability hazard).
     split_qkv: bool = False
+    # >0: compute the training loss with the chunked-vocab CE (online logsumexp
+    # over vocab chunks of this size, runtime/zero/tiling.py) instead of
+    # materialising (b, t, V) logits — the long-sequence memory knob (a 32k×50k
+    # logits buffer alone is 6.6 GB fp32)
+    vocab_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -286,7 +291,11 @@ class GPT2(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic: bool = True):
+    def __call__(self, input_ids, deterministic: bool = True,
+                 return_hidden: bool = False):
+        """``return_hidden``: return ``(final hidden states, wte)`` instead of
+        logits — the chunked-vocab CE path consumes these to avoid the
+        ``(b, t, V)`` logits buffer (6.6 GB at seq 32k × vocab 50k)."""
         cfg = self.config
         b, t = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(cfg.init_std),
@@ -314,6 +323,8 @@ class GPT2(nn.Module):
                 x = block(cfg, name=f"h_{i}")(x, deterministic)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            return x, wte
         # Tied LM head. bf16 operands + fp32 MXU accumulation: full-rate matmul (an fp32
         # matmul runs at ~1/4 MXU rate and this is ~25% of model FLOPs), fp32-accurate logits.
         logits = jax.lax.dot_general(
@@ -375,6 +386,15 @@ def gpt2_model(config: GPT2Config, sample_seq_len: Optional[int] = None,
             [ids[:, 1:], jnp.full((ids.shape[0], 1), -100, dtype=ids.dtype)], axis=1)
 
     def loss_fn(params, batch, rng):
+        if config.vocab_chunk:
+            from ..runtime.zero.tiling import chunked_vocab_cross_entropy
+            hidden, wte = module.apply({"params": params}, batch["input_ids"],
+                                       deterministic=False,
+                                       rngs={"dropout": rng},
+                                       return_hidden=True)
+            return chunked_vocab_cross_entropy(hidden, wte, _shift_labels(batch),
+                                               chunk=config.vocab_chunk,
+                                               compute_dtype=config.dtype)
         logits = module.apply({"params": params}, batch["input_ids"],
                               deterministic=False, rngs={"dropout": rng})
         return cross_entropy_loss(logits, _shift_labels(batch))

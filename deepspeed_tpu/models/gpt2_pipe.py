@@ -69,6 +69,10 @@ def gpt2_pipeline_module(config: GPT2Config, num_stages: int,
                          sample_batch_size: int = 1,
                          activation_checkpoint_interval: int = 1,
                          partition_method: str = "uniform") -> PipelineModule:
+    assert not getattr(config, "vocab_chunk", 0), \
+        ("GPT2Config.vocab_chunk is not wired into the pipeline's tied head "
+         "(the tail materialises full logits) — unset it for pipelined runs, "
+         "or use the non-pipelined gpt2_model for chunked-vocab training")
     t = sample_seq_len or config.n_positions
     sample = jnp.zeros((sample_batch_size, t), dtype=jnp.int32)
     layers = [

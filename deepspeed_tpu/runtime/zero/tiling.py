@@ -91,7 +91,8 @@ def tiled_kernel_from_dense(kernel: np.ndarray, in_splits: int, out_splits: int,
 
 def chunked_vocab_cross_entropy(x: jnp.ndarray, wte: jnp.ndarray,
                                 labels: jnp.ndarray, chunk: int = 8192,
-                                ignore_index: int = -100) -> jnp.ndarray:
+                                ignore_index: int = -100,
+                                compute_dtype=None) -> jnp.ndarray:
     """Mean next-token cross-entropy against a TIED embedding head without
     materialising ``(b, t, V)`` logits.
 
@@ -100,20 +101,27 @@ def chunked_vocab_cross_entropy(x: jnp.ndarray, wte: jnp.ndarray,
     ``V/chunk`` vocab slices carries running max/sumexp (online logsumexp — the same
     recurrence flash attention uses over keys) and picks each position's target score
     when its token falls inside the slice. Peak memory ``O(b·t·chunk)``.
+
+    ``compute_dtype`` (e.g. bf16) sets the head matmul's operand dtype with fp32
+    MXU accumulation — the same full-rate-matmul treatment the monolithic tied
+    head uses (an fp32 matmul runs at ~1/4 MXU rate and the head is ~25% of a
+    small model's FLOPs); the logsumexp carry stays fp32 either way.
     """
     b, t, d = x.shape
     V = wte.shape[0]
     pad = (-V) % chunk
     n_chunks = (V + pad) // chunk
-    x32 = x.astype(jnp.float32)
+    cd = compute_dtype or jnp.float32
     labels_flat = labels.reshape(-1)
-    xf = x32.reshape(-1, d)                                  # (N, d)
-    wte_p = jnp.pad(wte.astype(jnp.float32), ((0, pad), (0, 0)))
+    xf = x.astype(cd).reshape(-1, d)                         # (N, d)
+    wte_p = jnp.pad(wte.astype(cd), ((0, pad), (0, 0)))
 
     def body(carry, ci):
         m, s, tgt = carry
         w = jax.lax.dynamic_slice(wte_p, (ci * chunk, 0), (chunk, d))
-        logits = xf @ w.T                                    # (N, chunk)
+        logits = jax.lax.dot_general(
+            xf, w, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (N, chunk) fp32
         # padded vocab rows are embedding zeros → logit 0 for every position; mask
         cols = ci * chunk + jnp.arange(chunk)
         logits = jnp.where(cols[None, :] < V, logits, -1e30)
