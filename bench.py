@@ -166,8 +166,11 @@ def bench_inference():
     batch = int(os.environ.get("BENCH_INFER_BATCH", 1))
     iters = int(os.environ.get("BENCH_INFER_ITERS", 5))
 
+    # BENCH_MOE_EXPERTS>0 benches the MoE serving path (every 2nd layer's FFN is
+    # a gated expert mixture — reference moe_inference.py)
+    n_experts = int(os.environ.get("BENCH_MOE_EXPERTS", 0))
     cfg = gpt2_cfg(vocab_size=50304, max_seq_len=prompt_len + gen_len,
-                   n_embd=768, n_layer=12, n_head=12)
+                   n_embd=768, n_layer=12, n_head=12, num_experts=n_experts)
     engine = ds.init_inference(model=cfg, config={"dtype": "bfloat16",
                                                   "max_out_tokens": prompt_len + gen_len})
 
@@ -217,12 +220,15 @@ def bench_inference():
     ttft_p50 = sorted(ttfts)[len(ttfts) // 2] * 1e3 if ttfts else None
     tps = sorted(decode_tps)[len(decode_tps) // 2]
     out = {
-        "metric": "gpt2_125m_bf16_decode_tokens_per_sec",
+        "metric": ("gpt2_125m_moe_bf16_decode_tokens_per_sec" if n_experts
+                   else "gpt2_125m_bf16_decode_tokens_per_sec"),
         "value": round(tps, 2),
         "unit": "tokens/s",
         "vs_baseline": 1.0,
         "dispatch_rtt_ms": round(rtt * 1e3, 2),
     }
+    if n_experts:
+        out["num_experts"] = n_experts
     if ttft_p50 is not None:
         out["ttft_p50_ms"] = round(ttft_p50, 2)
     print(json.dumps(_with_gate(out)))
