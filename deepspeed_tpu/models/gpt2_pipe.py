@@ -64,29 +64,55 @@ def _tied_head_forward(base_layer, params, x):
     return x.astype(jnp.float32) @ params["wte"].T
 
 
+def _tied_hidden_forward(base_layer, params, x):
+    """Chunked-vocab head: pass (hidden, wte) through so the LOSS computes the
+    online-logsumexp CE without a (b, t, V) logits buffer (the pipelined analogue
+    of ``GPT2Config(vocab_chunk=N)``)."""
+    return (x, params["wte"])
+
+
 def gpt2_pipeline_module(config: GPT2Config, num_stages: int,
                          sample_seq_len: Optional[int] = None,
                          sample_batch_size: int = 1,
                          activation_checkpoint_interval: int = 1,
                          partition_method: str = "uniform") -> PipelineModule:
-    assert not getattr(config, "vocab_chunk", 0), \
-        ("GPT2Config.vocab_chunk is not wired into the pipeline's tied head "
-         "(the tail materialises full logits) — unset it for pipelined runs, "
-         "or use the non-pipelined gpt2_model for chunked-vocab training")
     t = sample_seq_len or config.n_positions
     sample = jnp.zeros((sample_batch_size, t), dtype=jnp.int32)
+    chunk = int(getattr(config, "vocab_chunk", 0) or 0)
+    if chunk:
+        # chunked-vocab tail: head layer passes (hidden, wte) through; the loss
+        # computes the online-logsumexp CE — no (b, t, V) logits on the last stage
+        from ..runtime.zero.tiling import chunked_vocab_cross_entropy
+        head_fn = _tied_hidden_forward
+        loss_fn = lambda out, lab: chunked_vocab_cross_entropy(
+            out[0], out[1], lab, chunk=chunk, compute_dtype=config.dtype)
+
+        def sp_loss_fn(out, lab, axis):
+            raise NotImplementedError(
+                "GPT2Config.vocab_chunk does not compose with a seq-sharded "
+                "pipeline tail yet — drop the seq mesh axis or unset vocab_chunk")
+    else:
+        head_fn = _tied_head_forward
+        loss_fn = cross_entropy_loss
+        sp_loss_fn = cross_entropy_loss_sp
     layers = [
         TiedLayerSpec("embed", _embed_layer, config),
         *[LayerSpec(_block_layer, config) for _ in range(config.n_layer)],
         LayerSpec(_norm_layer, config),
-        TiedLayerSpec("embed", _embed_layer, config, forward_fn=_tied_head_forward),
+        TiedLayerSpec("embed", _embed_layer, config, forward_fn=head_fn),
     ]
-    return PipelineModule(
+    mod = PipelineModule(
         layers=layers,
         num_stages=num_stages,
-        loss_fn=cross_entropy_loss,
-        sp_loss_fn=cross_entropy_loss_sp,
+        loss_fn=loss_fn,
+        sp_loss_fn=sp_loss_fn,
         sample_input=sample,
         partition_method=partition_method,
         activation_checkpoint_interval=activation_checkpoint_interval,
     )
+    if chunk:
+        # apply_fn keeps the (b, t, V) logits contract even though the head
+        # layer emits (hidden, wte) for the chunked loss
+        mod.apply_transform = lambda out: \
+            out[0].astype(jnp.float32) @ out[1].T
+    return mod

@@ -249,6 +249,9 @@ class PipelineModule:
         # sp_loss_fn(out_local, lab_local, axis_name): sequence-sharded tail loss
         # (psums its sum/count over the seq axis) — required for sp 1F1B
         self.sp_loss_fn = sp_loss_fn
+        # optional post-processing of reference_apply's output in to_model's
+        # apply_fn (keeps the logits contract when the head emits something else)
+        self.apply_transform = None
         self.seed = seed
         assert sample_input is not None, \
             "PipelineModule needs sample_input (abstract is fine) to trace layer shapes"
@@ -1018,7 +1021,13 @@ class PipelineModule:
 
         def apply_fn(params, batch, rng=None):
             inputs, _ = split_batch(batch)
-            return self.reference_apply(params, inputs, rng)
+            out = self.reference_apply(params, inputs, rng)
+            # builders whose head emits a non-logits payload (e.g. the chunked-
+            # vocab (hidden, wte) tuple) install a transform so apply_fn keeps
+            # the logits contract callers rely on
+            if self.apply_transform is not None:
+                out = self.apply_transform(out)
+            return out
 
         return Model(loss_fn=loss_fn, init_fn=self.init_fn, apply_fn=apply_fn,
                      param_specs=self.param_specs(tp_axis=tp_axis, tp_size=tp_size,

@@ -125,3 +125,48 @@ class TestTP1F1B:
         _, ref = run({"pipe": 2, "data": 4}, gas=2)
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
         assert got[-1] < got[0]
+
+
+class TestVocabChunkPipe:
+    def test_chunked_tail_matches_full(self, eight_devices):
+        """GPT2Config(vocab_chunk=N) in the PIPELINE: the tied head passes
+        (hidden, wte) through and the loss runs the online-logsumexp CE — loss
+        and grads equal the full-logits pipeline (no (b, t, V) buffer on the
+        last stage)."""
+        import numpy as np
+        batch_cfg = dict(TINY)
+        results = {}
+        for chunk in (0, 16):
+            cfg = GPT2Config(**batch_cfg, vocab_chunk=chunk)
+            mod = gpt2_pipeline_module(cfg, num_stages=2, sample_seq_len=32)
+            params = mod.init_fn(jax.random.PRNGKey(0))
+            batch = _batch()
+            mesh = MeshSpec({"pipe": 2}, eight_devices[:2])
+            fn = mod.make_1f1b_loss_fn(mesh)
+            loss, grads = jax.jit(jax.value_and_grad(fn))(
+                params, batch, jax.random.PRNGKey(7))
+            results[chunk] = (float(loss),
+                              jax.tree_util.tree_map(np.asarray, grads))
+        np.testing.assert_allclose(results[16][0], results[0][0], rtol=1e-5)
+        flat_c = dict(jax.tree_util.tree_leaves_with_path(results[16][1]))
+        for path, g in jax.tree_util.tree_leaves_with_path(results[0][1]):
+            np.testing.assert_allclose(flat_c[path], g, rtol=2e-4, atol=2e-5,
+                                       err_msg=jax.tree_util.keystr(path))
+
+    def test_chunked_apply_fn_keeps_logits_contract(self, eight_devices):
+        """apply_fn returns (b, t, V) logits even in chunked mode (the head's
+        (hidden, wte) payload is an internal loss detail)."""
+        import numpy as np
+        cfg = GPT2Config(**TINY, vocab_chunk=16)
+        mod = gpt2_pipeline_module(cfg, num_stages=2, sample_seq_len=32)
+        from deepspeed_tpu.parallel.mesh import set_global_mesh
+        set_global_mesh(MeshSpec({"pipe": 2}, eight_devices[:2]))
+        try:
+            model = mod.to_model()
+            params = mod.init_fn(jax.random.PRNGKey(0))
+            ids = np.random.RandomState(0).randint(0, 64, size=(2, 32)
+                                                   ).astype(np.int32)
+            out = model.apply_fn(params, {"inputs": ids, "labels": ids})
+            assert out.shape == (2, 32, 64), out.shape
+        finally:
+            set_global_mesh(None)
