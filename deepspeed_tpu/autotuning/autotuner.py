@@ -216,6 +216,14 @@ class Autotuner:
         log_dist(f"[autotuner] exploring {len(exps)} configurations "
                  f"({self.cfg.tuner_type})", ranks=[0])
         if self.cfg.experiment_runner:
+            if self.cfg.tuner_type != "gridsearch" or \
+                    self.cfg.tuner_num_trials < len(exps):
+                log_dist(
+                    f"[autotuner] experiment_runner set: tuner_type="
+                    f"{self.cfg.tuner_type!r}/tuner_num_trials/"
+                    f"tuner_early_stopping are ignored — all "
+                    f"{len(exps)} surviving configs launch as a full grid "
+                    f"under the subprocess scheduler", ranks=[0])
             best = self._tune_subprocess(exps)
         else:
             tuner = make_tuner(self.cfg.tuner_type, exps, self.cfg.metric)

@@ -24,6 +24,12 @@ class EncoderInferenceEngine:
                  mesh_spec: Optional[MeshSpec] = None, seed: int = 0):
         from .config import DeepSpeedInferenceConfig
         self._config = config or DeepSpeedInferenceConfig()
+        if self._config.is_int8():
+            raise NotImplementedError(
+                "int8 serving (dtype='int8' or quant.enabled) is not wired "
+                "into EncoderInferenceEngine (the decoder InferenceEngine has "
+                "it). Use dtype='bf16' for encoders, or serve through the "
+                "decoder engine's quantized path.")
         tp = self._config.resolved_tp()
         dp = max(1, int(self._config.data_parallel))
         self.mesh_spec = mesh_spec or MeshSpec(

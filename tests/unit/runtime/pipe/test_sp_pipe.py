@@ -1,8 +1,10 @@
 """Pipe×seq: ring/context parallelism inside the SPMD 1F1B pipeline.
 
 The body carries SEQUENCE-SHARDED activation chunks (cross-stage permutes shrink
-by the seq degree), attention is the ppermute K/V ring with online-softmax merge
-(``ring_attention_local``), pre/tail stay full-sequence (position-offset-free),
+by the seq degree), attention all-gathers K/V via grouped collectives per stage
+(``allgather_attention_local`` — a ppermute ring under pipe-staggered
+``lax.cond`` is undefined; see ``ops/attention/ring.py`` for the rationale),
+pre/tail stay full-sequence (position-offset-free),
 and the tail loss psums per-shard sum/count over the seq axis. Pinned: exact
 loss+grad equality against the replicated pipe run.
 """
