@@ -160,11 +160,11 @@ def bench_inference():
     from deepspeed_tpu.models import gpt2_cfg
 
     prompt_len = int(os.environ.get("BENCH_PROMPT", 512))
-    # long enough that on-device decode time dominates the (measured, subtracted)
-    # tunnel round-trips — keeps the corrected tok/s stable across RTT jitter
-    gen_len = int(os.environ.get("BENCH_GEN", 384))
+    # long enough that the differencing signal (dt_long - dt_short ≈ 550ms at 125M)
+    # dwarfs tunnel-RTT jitter (each generate() pays two host syncs, σ ≈ 40ms/diff)
+    gen_len = int(os.environ.get("BENCH_GEN", 1536))
     batch = int(os.environ.get("BENCH_INFER_BATCH", 1))
-    iters = int(os.environ.get("BENCH_INFER_ITERS", 5))
+    iters = int(os.environ.get("BENCH_INFER_ITERS", 13))
 
     # BENCH_MOE_EXPERTS>0 benches the MoE serving path (every 2nd layer's FFN is
     # a gated expert mixture — reference moe_inference.py)

@@ -217,7 +217,8 @@ class Autotuner:
                  f"({self.cfg.tuner_type})", ranks=[0])
         if self.cfg.experiment_runner:
             if self.cfg.tuner_type != "gridsearch" or \
-                    self.cfg.tuner_num_trials < len(exps):
+                    min(self.cfg.tuner_num_trials,
+                        self.cfg.tuner_early_stopping) < len(exps):
                 log_dist(
                     f"[autotuner] experiment_runner set: tuner_type="
                     f"{self.cfg.tuner_type!r}/tuner_num_trials/"

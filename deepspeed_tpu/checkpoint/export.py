@@ -158,23 +158,17 @@ def _unflatten(named: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return root
 
 
-def export_universal_checkpoint(engine, save_dir: str,
-                                tag: str = "universal") -> str:
-    """Write the engine's state as a reference universal checkpoint.
-
-    Layout under ``save_dir/tag``::
-
-        zero/<param_name>/fp32.pt         {"param": fp32 tensor, "cat_dim": 0}
-        zero/<param_name>/exp_avg.pt      (when Adam moments exist)
-        zero/<param_name>/exp_avg_sq.pt
-        mp_rank_00_model_states.pt        module weights + param_shapes + iteration
-        latest_universal                  tag pointer
-
-    Returns the checkpoint path.
-    """
+def _write_universal(params: Dict[str, np.ndarray],
+                     m_named: Optional[Dict[str, np.ndarray]],
+                     v_named: Optional[Dict[str, np.ndarray]],
+                     step: int, save_dir: str, tag: str,
+                     layer_files: Optional[Dict[str, Dict[str, np.ndarray]]]
+                     = None) -> str:
+    """Writer shared by all export entry points (see layout in
+    :func:`export_universal_checkpoint`). ``layer_files`` adds reference
+    pipeline-style per-layer files (``layer_XX-model_00-model_states.pt``)."""
     import torch
 
-    params, m_named, v_named, step = _gather_engine_state(engine)
     path = os.path.join(save_dir, str(tag))
     zero_dir = os.path.join(path, "zero")
     os.makedirs(zero_dir, exist_ok=True)
@@ -192,6 +186,10 @@ def export_universal_checkpoint(engine, save_dir: str,
                 np.ascontiguousarray(v_named[name])), CAT_DIM: 0},
                 os.path.join(pdir, f"{EXP_AVG_SQ}.pt"))
 
+    for fname, tensors in (layer_files or {}).items():
+        torch.save({n: torch.from_numpy(np.ascontiguousarray(a))
+                    for n, a in tensors.items()}, os.path.join(path, fname))
+
     module = _unflatten({n: torch.from_numpy(np.ascontiguousarray(a))
                          for n, a in params.items()})
     shapes = OrderedDict((n, tuple(a.shape)) for n, a in params.items())
@@ -203,6 +201,179 @@ def export_universal_checkpoint(engine, save_dir: str,
     logger.info(f"universal checkpoint exported to {path} "
                 f"({len(params)} params, step {step})")
     return path
+
+
+def export_universal_checkpoint(engine, save_dir: str,
+                                tag: str = "universal") -> str:
+    """Write the engine's state as a reference universal checkpoint.
+
+    Layout under ``save_dir/tag``::
+
+        zero/<param_name>/fp32.pt         {"param": fp32 tensor, "cat_dim": 0}
+        zero/<param_name>/exp_avg.pt      (when Adam moments exist)
+        zero/<param_name>/exp_avg_sq.pt
+        mp_rank_00_model_states.pt        module weights + param_shapes + iteration
+        latest_universal                  tag pointer
+
+    Pipeline engines additionally get reference per-layer files
+    (``layer_XX-model_00-model_states.pt``, reference ``runtime/pipe/module.py:570``)
+    with the stacked body un-stacked per pipeline position. Returns the path.
+    """
+    from ..runtime.pipe.engine import PipelineEngine
+
+    if isinstance(engine, PipelineEngine):
+        params, m_named, v_named, step, layer_files = \
+            _gather_pipeline_state(engine)
+        return _write_universal(params, m_named, v_named, step, save_dir, tag,
+                                layer_files=layer_files)
+    params, m_named, v_named, step = _gather_engine_state(engine)
+    return _write_universal(params, m_named, v_named, step, save_dir, tag)
+
+
+def _gather_pipeline_state(engine):
+    """Un-stack a PipelineEngine's pre/body(stacked)/post/tied tree into per-layer
+    dotted names (``<pos>.<param>``; the stacked body leaf ``body.x.y`` of shape
+    ``(L, ...)`` becomes ``<pos_i>.x.y`` per body layer i — reference per-layer
+    checkpoint naming, ``runtime/pipe/module.py:570``) plus per-layer files."""
+    module = engine.pipeline_module
+    params = engine.state.params
+    step = int(getattr(engine, "global_steps", 0))
+    opt = engine.state.opt_state
+    has_moments = hasattr(opt, "exp_avg") and hasattr(opt, "exp_avg_sq")
+
+    def unstack(seg_tree, take):
+        """body leaves → per-layer dicts: {local_sub_name: arr[take]}"""
+        return {n: a[take] for n, a in _dotted_tree(seg_tree).items()}
+
+    out_p: Dict[str, np.ndarray] = {}
+    out_m: Dict[str, np.ndarray] = {}
+    out_v: Dict[str, np.ndarray] = {}
+    layer_files: Dict[str, Dict[str, np.ndarray]] = {}
+
+    tied_seen = set()
+    for i in range(len(module._layers)):
+        lk = f"{i:02d}"
+        key = module._tied_keys[i]
+        if key is not None:
+            if key in tied_seen:
+                continue            # tied reuse: saved at its first position
+            tied_seen.add(key)
+            named = _dotted_tree(params["tied"][key])
+            sub_m = _dotted_tree(opt.exp_avg["tied"][key]) if has_moments \
+                else None
+            sub_v = _dotted_tree(opt.exp_avg_sq["tied"][key]) if has_moments \
+                else None
+        elif module.body_start <= i < module.body_end:
+            bi = i - module.body_start
+            named = unstack(params["body"], bi)
+            sub_m = unstack(opt.exp_avg["body"], bi) if has_moments else None
+            sub_v = unstack(opt.exp_avg_sq["body"], bi) if has_moments else None
+        else:
+            seg = "pre" if i < module.body_start else "post"
+            if str(i) not in params[seg]:
+                continue            # parameterless layer
+            named = _dotted_tree(params[seg][str(i)])
+            sub_m = (_dotted_tree(opt.exp_avg[seg][str(i)])
+                     if has_moments else None)
+            sub_v = (_dotted_tree(opt.exp_avg_sq[seg][str(i)])
+                     if has_moments else None)
+        layer_files[f"layer_{lk}-model_00-model_states.pt"] = named
+        for n, a in named.items():
+            out_p[f"{lk}.{n}"] = a
+            if sub_m is not None:
+                out_m[f"{lk}.{n}"] = sub_m[n]
+                out_v[f"{lk}.{n}"] = sub_v[n]
+    if not has_moments:
+        logger.warning(
+            "universal export: pipeline optimizer state has no exp_avg/"
+            "exp_avg_sq — the checkpoint carries weights only")
+    return (out_p, out_m or None, out_v or None, step, layer_files)
+
+
+def consolidate_partitioned_checkpoint(ckpt_dir: str, tag: str, save_dir: str,
+                                       out_tag: str = "universal") -> str:
+    """OFFLINE consolidation of a multi-process partitioned offload run: read every
+    rank's ``offload_state_part{r}.npz`` partition file, merge the owned master
+    shards into full fp32 leaves, and write one universal checkpoint — the
+    partitioned-tier analogue of ``zero_to_fp32`` (reference
+    ``utils/zero_to_fp32.py:483`` consolidating per-rank zero shards).
+
+    No engine or mesh needed: the partition files are self-describing
+    (``ParamOffloadCoordinator._partition_meta``).
+    """
+    import glob
+    import json
+
+    prefix = os.path.join(ckpt_dir, str(tag), "offload_state")
+    files = sorted(glob.glob(prefix + "_part*.npz"),
+                   key=lambda f: int(f.rsplit("_part", 1)[1].split(".")[0]))
+    if not files:
+        raise FileNotFoundError(
+            f"no partition files matching {prefix}_part*.npz — was this "
+            "checkpoint written by a multi-process offload_param run?")
+
+    full: Dict[str, np.ndarray] = {}
+    m_full: Dict[str, np.ndarray] = {}
+    v_full: Dict[str, np.ndarray] = {}
+    step = 0
+    meta0 = None
+    for f in files:
+        with np.load(f) as data:
+            if "meta_json" not in data:
+                raise ValueError(
+                    f"{f} has no partition metadata (written by a pre-r5 "
+                    "version) — re-save the checkpoint, or resume "
+                    "single-process and export from the engine")
+            meta = json.loads(bytes(data["meta_json"]).decode())
+            meta0 = meta0 or meta
+            if len(files) != meta["n_ranks"]:
+                raise ValueError(
+                    f"found {len(files)} partition files but the run had "
+                    f"{meta['n_ranks']} ranks — a missing rank file would "
+                    "leave its shards uninitialized in the consolidation")
+            if meta["nvme_params"]:
+                raise NotImplementedError(
+                    "consolidating an NVMe-partitioned run: masters live in the "
+                    f"per-rank {prefix}_masters_p<r> directories, not the "
+                    "partition files — resume on the writing topology and "
+                    "export from the engine")
+            step = max(step, int(data["step"]))
+            has_moments = (meta["kind"] in ("adam", "adamw")
+                           and not meta["nvme_moments"])
+            for i, slot in enumerate(meta["slots"]):
+                if not slot["owned"]:
+                    continue
+                name = meta["leaf_names"][slot["key"]][slot["li"]]
+                lshape = tuple(meta["leaf_shapes"][slot["key"]][slot["li"]])
+                sl = tuple(slice(a, b) for a, b in slot["slice"])
+                sshape = tuple(b - a for a, b in slot["slice"])
+                if name not in full:
+                    full[name] = np.empty(lshape, np.float32)
+                full[name][sl] = np.asarray(data[f"master_{i}"],
+                                            np.float32).reshape(sshape)
+                if has_moments:
+                    if name not in m_full:
+                        m_full[name] = np.empty(lshape, np.float32)
+                        v_full[name] = np.empty(lshape, np.float32)
+                    m_full[name][sl] = np.asarray(data[f"m_{i}"],
+                                                  np.float32).reshape(sshape)
+                    v_full[name][sl] = np.asarray(data[f"v_{i}"],
+                                                  np.float32).reshape(sshape)
+
+    expected = {n for k, names in meta0["leaf_names"].items() for n in names}
+    missing = expected - set(full)
+    if missing:
+        raise ValueError(
+            f"partition files do not cover every leaf (missing {sorted(missing)[:4]}"
+            f"...): expected {meta0['n_ranks']} ranks, found {len(files)} files")
+    if meta0["kind"] not in ("adam", "adamw") or meta0["nvme_moments"]:
+        logger.warning(
+            "consolidation: optimizer moments unavailable offline for kind="
+            f"{meta0['kind']!r} (nvme_moments={meta0['nvme_moments']}) — the "
+            "universal checkpoint carries weights only")
+        m_full = v_full = {}
+    return _write_universal(full, m_full or None, v_full or None, step,
+                            save_dir, out_tag)
 
 
 def export_fp32_state_dict(engine, out_file: str) -> Dict[str, Any]:

@@ -58,6 +58,14 @@ class CausalLMConfig:
     num_experts: int = 0
     moe_layer_interval: int = 2
     moe_top_k: int = 1
+    # decode (t==1) routes via selected-expert weight GATHER instead of the all-expert
+    # dispatch einsum — e× less FFN HBM traffic per step (reference builds dedicated MoE
+    # inference ops for this hot loop, ``moe_inference.py:463``). False = always dispatch
+    # (debug / parity testing).
+    moe_decode_fastpath: bool = True
+    # "pallas" = gather-fused kernel (weights stream HBM→MXU once);
+    # "xla" = w[idx] gather + einsum (lets XLA pin small expert stacks in VMEM)
+    moe_decode_impl: str = "pallas"
 
     def is_moe_layer(self, i: int) -> bool:
         return self.num_experts > 0 and (i + 1) % self.moe_layer_interval == 0
@@ -256,6 +264,7 @@ class CausalLMLayer(nn.Module):
         does not change results; the reference's inference MoE has no capacity dropping
         either), experts sharded over the ``expert`` axis."""
         from ..moe.sharded_moe import TopKGate
+        from ..parallel.mesh import AXIS_EXPERT, get_global_mesh
         cfg = self.config
         b, t, d = h.shape
         s = b * t
@@ -270,6 +279,30 @@ class CausalLMLayer(nn.Module):
                                         name="moe_experts")()
         act = _act(cfg)
         cdtype = cfg.dtype
+        mesh = get_global_mesh()
+        expert_sharded = mesh is not None and mesh.size(AXIS_EXPERT) > 1
+        if (t == 1 and cfg.moe_decode_fastpath and not expert_sharded
+                and cfg.num_experts > cfg.moe_top_k):
+            # decode fast path: a (b, 1, d) step touches at most b*k experts; the
+            # gather-fused kernel streams just those experts' weights instead of
+            # running every expert's FFN on a mostly-zero dispatch tensor. Routing
+            # semantics shared with the dispatch path via topk_select (parity pinned
+            # in tests/unit/moe/test_moe_decode.py).
+            from ..moe.sharded_moe import topk_select
+            from ..ops.moe import moe_decode_ffn, moe_decode_ffn_xla
+            k = cfg.moe_top_k
+            logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)       # (b, e)
+            idx, gw = topk_select(logits, k)                              # (b, k) ×2
+            xk = x.astype(cdtype)
+            if k > 1:
+                xk = jnp.repeat(xk, k, axis=0)                            # (b*k, d)
+            ffn = (moe_decode_ffn_xla if cfg.moe_decode_impl == "xla"
+                   else moe_decode_ffn)
+            y = ffn(xk, idx.reshape(-1),
+                    w1.astype(cdtype), b1.astype(cdtype),
+                    w2.astype(cdtype), b2.astype(cdtype), act)
+            out = jnp.einsum("bk,bkm->bm", gw, y.reshape(b, k, d))
+            return out.reshape(b, t, d).astype(h.dtype)
 
         def expert_fn(expert_in):                       # (e, c, m) → (e, c, m)
             hh = jnp.einsum("ecm,emf->ecf", expert_in, w1.astype(cdtype)) + \
@@ -282,25 +315,24 @@ class CausalLMLayer(nn.Module):
             _, combine, dispatch, _ = gate(wg, tokens, train=False, rng=None)
             return combine, dispatch
 
-        from ..parallel.mesh import AXIS_EXPERT, get_global_mesh
-        mesh = get_global_mesh()
         e = cfg.num_experts
         chunk = min(s, self.MOE_CHUNK)
         pad = (-s) % chunk
         xc = jnp.pad(x, ((0, pad), (0, 0))).reshape(-1, chunk, d)     # (n, C, m)
         n = xc.shape[0]
-        combine, dispatch = jax.vmap(gating)(xc)                      # (n, C, e, C)
+        combine, dispatch = jax.vmap(gating)(xc)                      # (n, C, e, cap)
+        cap = combine.shape[-1]          # == chunk for top-1, 2*chunk for top-2 (no-drop)
         expert_in = jnp.einsum("nsec,nsm->encm", dispatch.astype(jnp.float32),
                                xc.astype(jnp.float32)).astype(cdtype)
-        expert_in = expert_in.reshape(e, n * chunk, d)
-        if mesh is not None and mesh.size(AXIS_EXPERT) > 1:
+        expert_in = expert_in.reshape(e, n * cap, d)
+        if expert_sharded:
             expert_in = jax.lax.with_sharding_constraint(
                 expert_in, mesh.sharding(P(AXIS_EXPERT, None, None)))
-        expert_out = expert_fn(expert_in)                             # (e, nC, m)
-        if mesh is not None and mesh.size(AXIS_EXPERT) > 1:
+        expert_out = expert_fn(expert_in)                             # (e, n*cap, m)
+        if expert_sharded:
             expert_out = jax.lax.with_sharding_constraint(
                 expert_out, mesh.sharding(P(AXIS_EXPERT, None, None)))
-        expert_out = expert_out.reshape(e, n, chunk, d)
+        expert_out = expert_out.reshape(e, n, cap, d)
         out = jnp.einsum("nsec,encm->nsm", combine.astype(jnp.float32),
                          expert_out.astype(jnp.float32))
         out = out.reshape(-1, d)[:s]

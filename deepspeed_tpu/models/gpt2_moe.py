@@ -30,6 +30,9 @@ class GPT2MoEConfig(GPT2Config):
     noisy_gate_policy: Optional[str] = "RSample"
     moe_loss_coef: float = 0.01
     use_residual: bool = False
+    # token-dim sharding axes threaded to moe.layer.MoE.token_axes; the pipeline module
+    # overrides to () because inside its manual shard_map these axes are not GSPMD-visible
+    moe_token_axes: tuple = ("data", "fsdp", "seq")
 
 
 class MoEBlock(nn.Module):
@@ -72,6 +75,7 @@ class MoEBlock(nn.Module):
             use_residual=cfg.use_residual,
             dtype=cfg.dtype,
             init_std=cfg.init_std,
+            token_axes=tuple(cfg.moe_token_axes),
             name="moe")(h, deterministic=deterministic)
         self.sow("losses", "moe_l_aux", l_aux)
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)

@@ -13,6 +13,7 @@ The body unit is a dense+MoE PAIR (the reference's alternating NLG architecture,
 ``moe_layer_interval=2``) so the stage scan sees a homogeneous parameter stack.
 """
 
+import dataclasses
 from typing import Optional
 
 import flax.linen as nn
@@ -91,6 +92,10 @@ def gpt2_moe_pipeline_module(config: GPT2MoEConfig, num_stages: int,
         "the pipelined MoE body pairs one dense with one MoE block " \
         f"(moe_layer_interval=2); got interval {config.moe_layer_interval}"
     assert config.n_layer % 2 == 0, "n_layer must be even (dense+MoE pairs)"
+    if config.moe_token_axes:
+        # body layers run inside the pipe's manual shard_map where data/fsdp/seq are
+        # manual axes — a GSPMD sharding constraint naming them would be an error
+        config = dataclasses.replace(config, moe_token_axes=())
     t = sample_seq_len or config.n_positions
     sample = jnp.zeros((sample_batch_size, t), dtype=jnp.int32)
     layers = [

@@ -7,12 +7,14 @@ reference's process-group plumbing (``_create_expert_and_data_parallel``) is rep
 mesh axis + sharding constraints.
 """
 
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
+from ..parallel.mesh import get_global_mesh
 from .experts import Experts
 from .sharded_moe import TopKGate, moe_dispatch_combine
 
@@ -34,6 +36,22 @@ class MoE(nn.Module):
     activation: Callable = nn.gelu
     dtype: jnp.dtype = jnp.bfloat16
     init_std: float = 0.02
+    # mesh axes the flattened token dim is sharded over ((batch, seq) collapse order).
+    # Pinning tokens/combine/dispatch to one explicit sharding stops GSPMD from inventing
+    # conflicting shardings for the tiny gating tensors (it otherwise folds the expert
+    # axis into the token dim on one side of the graph and falls back to an "Involuntary
+    # full rematerialization" replicate-reshard). Empty tuple = no constraint — required
+    # inside pipe-manual shard_map regions where these axes are not GSPMD-visible.
+    token_axes: Tuple[str, ...] = ("data", "fsdp", "seq")
+
+    def _token_spec(self, extra_dims: int):
+        mesh = get_global_mesh()
+        if mesh is None:
+            return None
+        axes = tuple(ax for ax in self.token_axes if mesh.size(ax) > 1)
+        if not axes:
+            return None
+        return mesh.sharding(P(axes, *([None] * extra_dims)))
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -41,6 +59,9 @@ class MoE(nn.Module):
         d_ff = self.ffn_hidden_size or 4 * m
         orig_shape = x.shape
         tokens = x.reshape(-1, m)
+        tok_sharding = self._token_spec(extra_dims=1)
+        if tok_sharding is not None:
+            tokens = jax.lax.with_sharding_constraint(tokens, tok_sharding)
 
         wg = self.param("gate_wg", nn.initializers.normal(self.init_std),
                         (m, self.num_experts), jnp.float32)
@@ -55,11 +76,17 @@ class MoE(nn.Module):
                else None)
         l_aux, combine, dispatch, exp_counts = gate(
             wg, tokens, train=not deterministic, rng=rng)
+        sec_sharding = self._token_spec(extra_dims=2)
+        if sec_sharding is not None:
+            combine = jax.lax.with_sharding_constraint(combine, sec_sharding)
+            dispatch = jax.lax.with_sharding_constraint(dispatch, sec_sharding)
 
         experts = Experts(num_experts=self.num_experts, d_model=m, d_ff=d_ff,
                           activation=self.activation, dtype=self.dtype,
                           init_std=self.init_std, name="experts")
         y = moe_dispatch_combine(tokens, combine, dispatch, experts)
+        if tok_sharding is not None:
+            y = jax.lax.with_sharding_constraint(y, tok_sharding)
 
         if self.use_residual:
             # Residual MoE (reference ``layer.py:residual_mlp``): dense MLP branch mixed with

@@ -158,6 +158,29 @@ def top2gating(logits: jnp.ndarray,
     return l_aux, combine, dispatch, exp_counts
 
 
+def topk_select(logits: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Eval-mode expert selection: ``(idx (s, k) int32, weights (s, k) f32)``.
+
+    The index/weight half of ``top1gating``/``top2gating`` at eval settings (no noise,
+    no drops): top-1 weight is the UNNORMALISED softmax prob of the argmax expert
+    (``top1gating`` ``gates1``); top-2 masks the first choice before the second argmax
+    and renormalises the pair with the same 1e-9 clamp (``top2gating``). Owned here so
+    serving fast paths (selected-expert weight gather, ``causal_lm._moe_mlp``) share
+    routing semantics with the dispatch path by construction."""
+    assert k in (1, 2), "only top-1 and top-2 gating are supported (reference limit)"
+    e = logits.shape[-1]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if k == 1:
+        idx = jnp.argmax(logits, axis=-1)[:, None]                    # (s, 1)
+        return idx, jnp.take_along_axis(gates, idx, axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    masked = jnp.where(jax.nn.one_hot(idx1, e, dtype=bool), -jnp.inf,
+                       logits.astype(jnp.float32))
+    idx = jnp.stack([idx1, jnp.argmax(masked, axis=-1)], axis=-1)     # (s, 2)
+    g = jnp.take_along_axis(gates, idx, axis=-1)
+    return idx, g / jnp.clip(g.sum(-1, keepdims=True), 1e-9, None)
+
+
 class TopKGate:
     """Gate projection + top-k routing (reference ``TopKGate:351``).
 

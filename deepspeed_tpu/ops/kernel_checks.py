@@ -89,6 +89,23 @@ def check_block_sparse() -> float:
                                                     causal=True))
 
 
+def check_moe_decode_ffn() -> float:
+    import jax
+    import jax.numpy as jnp
+    from .moe.decode_ffn import moe_decode_ffn, moe_decode_ffn_xla
+    rng = np.random.RandomState(3)
+    e, d, f, n = 8, 768, 3072, 4
+    x = jnp.asarray(rng.standard_normal((n, d)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((e, d, f)) * d ** -0.5, jnp.bfloat16)
+    b1 = jnp.asarray(rng.standard_normal((e, f)) * 0.02, jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((e, f, d)) * f ** -0.5, jnp.bfloat16)
+    b2 = jnp.asarray(rng.standard_normal((e, d)) * 0.02, jnp.bfloat16)
+    idx = jnp.asarray(rng.randint(0, e, size=(n,)), jnp.int32)
+    act = jax.nn.gelu
+    o1 = jax.jit(lambda *a: moe_decode_ffn(*a, act=act))(x, idx, w1, b1, w2, b2)
+    return _err(o1, moe_decode_ffn_xla(x, idx, w1, b1, w2, b2, act))
+
+
 # name → (check fn, max-abs-err tolerance for the check's dtype/shape)
 KERNEL_CHECKS: Dict[str, Tuple] = {
     "flash_fwd": (check_flash_fwd, 0.02),       # fp32
@@ -96,6 +113,7 @@ KERNEL_CHECKS: Dict[str, Tuple] = {
     "flash_alibi": (check_flash_alibi, 0.05),   # bf16
     "decode": (check_decode, 0.03),             # bf16
     "block_sparse": (check_block_sparse, 0.03),  # bf16
+    "moe_decode_ffn": (check_moe_decode_ffn, 0.03),  # bf16
 }
 
 

@@ -1,0 +1,3 @@
+from .decode_ffn import moe_decode_ffn, moe_decode_ffn_xla
+
+__all__ = ["moe_decode_ffn", "moe_decode_ffn_xla"]

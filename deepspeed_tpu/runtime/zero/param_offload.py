@@ -47,6 +47,18 @@ from ...utils.logging import log_dist
 from ..fp16.loss_scaler import DynamicLossScaler, LossScaleState
 
 
+def _leaf_dotted_names(key: str, treedef) -> List[str]:
+    """Dotted reference-style names of a segment's leaves, in tree-leaf order —
+    the same names ``checkpoint.export._dotted_tree`` produces for the full tree."""
+    dummy = jax.tree_util.tree_unflatten(treedef, list(range(treedef.num_leaves)))
+    flat, _ = jax.tree_util.tree_flatten_with_path(dummy)
+    names = [""] * treedef.num_leaves
+    for path, leaf_i in flat:
+        parts = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        names[leaf_i] = ".".join([key] + parts)
+    return names
+
+
 class _StreamCache:
     """2-deep window of device-resident segment parameter trees.
 
@@ -1008,13 +1020,38 @@ class ParamOffloadCoordinator:
                                         dtype=np.float32).reshape(-1))
         self._restore_scaler(sd)
 
+    def _partition_meta(self) -> dict:
+        """Self-describing layout of this rank's partition file: enables OFFLINE
+        consolidation (``checkpoint.export.consolidate_partitioned_checkpoint``)
+        without reconstructing the coordinator or its mesh."""
+        return {
+            "version": 1,
+            "n_ranks": jax.process_count(),
+            "rank": jax.process_index(),
+            "kind": self.kind,
+            "nvme_params": bool(self.nvme_params),
+            "nvme_moments": self.nvme is not None,
+            "slots": [
+                {"key": k, "li": li,
+                 "slice": [[int(a), int(b)] for a, b in nk],
+                 "owned": bool(owned)}
+                for (k, li, nk, _shape, owned) in self._slot_meta],
+            "leaf_names": {k: _leaf_dotted_names(k, self.key_treedef[k])
+                           for k in self._key_order},
+            "leaf_shapes": {k: [list(s) for s in self.key_shapes[k]]
+                            for k in self._key_order},
+        }
+
     def save_to(self, checkpoint_engine, path: str):
         if self._partitioned:
             # one partition file per process (reference per-rank zero_pp_rank_*
             # files) — resume requires the topology that wrote it
+            import json
             rank = jax.process_index()
             data = {f"master_{i}": m for i, m in
                     enumerate(self._masters_p or [])}
+            data["meta_json"] = np.frombuffer(
+                json.dumps(self._partition_meta()).encode(), np.uint8)
             data["step"] = np.int64(getattr(self, "step_count", 0))
             if self.scaler_state is not None:
                 data["scaler"] = self._light_state_dict()["scaler"]

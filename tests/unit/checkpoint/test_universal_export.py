@@ -130,6 +130,70 @@ class TestUniversalExport:
             np.testing.assert_array_equal(got_m.reshape(-1), co_m[i],
                                           err_msg=name)
 
+    def test_pipeline_engine_export(self, tmp_path, eight_devices):
+        """1F1B-trained pipeline export (VERDICT r4 item 4): the stacked body is
+        un-stacked into reference per-layer files + per-layer dotted universal
+        names, and the export re-imports through DeepSpeedCheckpoint exactly."""
+        from deepspeed_tpu.models.gpt2 import GPT2Config
+        from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+        from deepspeed_tpu.parallel.mesh import MeshSpec
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+        gcfg = GPT2Config(vocab_size=VOCAB, n_positions=32, n_embd=32,
+                          n_layer=4, n_head=4, dropout=0.0,
+                          dtype=jax.numpy.float32, split_qkv=True,
+                          scan_layers=False, remat=False)
+        mod = gpt2_pipeline_module(gcfg, num_stages=2, sample_seq_len=SEQ)
+        mesh = MeshSpec({"pipe": 2, "data": 2}, eight_devices[:4])
+        eng = PipelineEngine(model=mod, config={
+            "train_batch_size": 4, "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"pipe": 2, "data": 2}, "steps_per_print": 10**9,
+        }, mesh_spec=mesh)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, VOCAB, size=(4, SEQ)).astype(np.int32)
+        for _ in range(2):
+            eng.train_batch(batch={"inputs": ids, "labels": ids})
+
+        path = export_universal_checkpoint(eng, str(tmp_path), tag="u1")
+
+        # (a) body un-stacking: layer file at body position i holds slice i
+        params = eng.state.params
+        bs = mod.body_start
+        for i in range(bs, mod.body_end):
+            f = os.path.join(path, f"layer_{i:02d}-model_00-model_states.pt")
+            assert os.path.isfile(f), f
+            sd = torch.load(f, weights_only=False)
+            for name, t in sd.items():
+                node = params["body"]
+                for p in name.split("."):
+                    node = node[p]
+                np.testing.assert_array_equal(
+                    t.numpy(), np.asarray(node, np.float32)[i - bs],
+                    err_msg=f"layer {i} {name}")
+        # (b) tied embedding at its first position; final norm in its post slot
+        sd0 = torch.load(os.path.join(
+            path, "layer_00-model_00-model_states.pt"), weights_only=False)
+        np.testing.assert_array_equal(
+            sd0["wte"].numpy(),
+            np.asarray(params["tied"]["embed"]["wte"], np.float32))
+        # (c) universal zero/ entries + moments, and re-import equality
+        ckpt = DeepSpeedCheckpoint(path)
+        assert ckpt.get_iteration() == 2
+        merged = ckpt.merged_state_dict()
+        got = merged["01.q_attn.kernel"]
+        np.testing.assert_array_equal(
+            np.asarray(got),
+            np.asarray(params["body"]["q_attn"]["kernel"], np.float32)[1 - bs])
+        m_file = os.path.join(path, "zero", "01.q_attn.kernel", "exp_avg.pt")
+        got_m = torch.load(m_file, weights_only=False)["param"].numpy()
+        np.testing.assert_array_equal(
+            got_m,
+            np.asarray(eng.state.opt_state.exp_avg["body"]["q_attn"]["kernel"],
+                       np.float32)[1 - bs])
+
     def test_fp32_state_dict(self, tmp_path):
         eng = _engine()
         out = str(tmp_path / "pytorch_model.bin")

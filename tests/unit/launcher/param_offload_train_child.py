@@ -72,6 +72,16 @@ def main():
     # verify the partition file restored it
     ckpt = os.path.join(args.out, "ckpt")
     engine.save_checkpoint(ckpt, tag="t0")
+    # ground truth for the OFFLINE consolidation check: the full pushed params
+    # at checkpoint time (push reshards masters to replicated f32). _push_key is
+    # COLLECTIVE — every rank participates; rank 0 writes the artifact.
+    from deepspeed_tpu.checkpoint.export import _dotted_tree
+    full = {k: jax.tree_util.tree_map(
+                lambda l: np.array(l, np.float32, copy=True),
+                co._push_key(k)[0]) for k in co._key_order}
+    if rank == 0:
+        np.savez(os.path.join(args.out, "expected_full.npz"),
+                 **_dotted_tree(full))
     saved0 = co._masters_p[0].copy()
     co._masters_p[0][:] = 7.25
     engine.load_checkpoint(ckpt, tag="t0")

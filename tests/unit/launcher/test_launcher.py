@@ -134,6 +134,29 @@ class TestLocalLaunch:
         assert r0["decreased"] and r1["decreased"]
         assert r0["resumed_loss_finite"] and r1["resumed_loss_finite"]
 
+        # OFFLINE consolidation (VERDICT r4 item 4): merge the per-rank
+        # partition files into one universal checkpoint with no engine/mesh,
+        # and verify exact equality against the pushed full params
+        import numpy as np
+        from deepspeed_tpu.checkpoint import DeepSpeedCheckpoint
+        from deepspeed_tpu.checkpoint.export import \
+            consolidate_partitioned_checkpoint
+        out = consolidate_partitioned_checkpoint(
+            str(tmp_path / "ckpt"), "t0", str(tmp_path / "univ"))
+        expected = np.load(tmp_path / "expected_full.npz")
+        merged = DeepSpeedCheckpoint(out).merged_state_dict()
+        assert set(expected.files) == set(merged.keys())
+        for name in expected.files:
+            np.testing.assert_array_equal(np.asarray(merged[name]),
+                                          expected[name], err_msg=name)
+        # adamw RAM moments consolidated too
+        some = sorted(expected.files)[0]
+        m_file = os.path.join(out, "zero", some, "exp_avg.pt")
+        assert os.path.isfile(m_file), m_file
+        import torch
+        got_m = torch.load(m_file, weights_only=False)["param"]
+        assert tuple(got_m.shape) == expected[some].shape
+
     def test_failure_propagates(self, tmp_path):
         """A failing rank propagates its exit code through the spawner (reference
         launch.py poll loop)."""
