@@ -336,7 +336,9 @@ def bench_inference_7b():
 
     prompt_len = int(os.environ.get("BENCH_PROMPT", 512))
     iters = int(os.environ.get("BENCH_7B_ITERS", 3))
-    batch = 1
+    # batched serving throughput (reference inference story is per-GPU THROUGHPUT,
+    # engine.py:541 forward batching): decode_tokens_per_sec is the batch aggregate
+    batch = int(os.environ.get("BENCH_7B_BATCH", 1))
 
     # BLOOM-7B1 shape: 30 layers, hidden 4096, 32 heads, alibi, vocab 250880
     cfg = bloom_cfg(vocab_size=250880, max_seq_len=prompt_len + 64,
@@ -410,7 +412,7 @@ def bench_inference_7b():
     # position (logits_positions), not all prompt_len — billing it per-position
     # would overstate MFU by ~1.14x at BLOOM's 250k vocab.
     vd = cfg.vocab_size * cfg.n_embd
-    flops_prefill = 2.0 * ((cfg.num_params() - vd) * prompt_len + vd)
+    flops_prefill = 2.0 * ((cfg.num_params() - vd) * prompt_len + vd) * batch
     prefill_tflops = flops_prefill / (prefill_exec_p50 / 1e3) / 1e12
     peak = peak_tflops()
     # Headline keeps the round-3 methodology (single-shot TTFT minus one measured
@@ -430,6 +432,7 @@ def bench_inference_7b():
         "prefill_exec_p50_ms": round(prefill_exec_p50, 2),
         "prefill_tflops": round(prefill_tflops, 1),
         "decode_tokens_per_sec": round(decode_p50, 2),
+        "batch": batch,
     }
     if peak:
         out["prefill_mfu"] = round(prefill_tflops / peak, 4)

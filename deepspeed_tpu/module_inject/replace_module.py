@@ -98,6 +98,68 @@ def _convert_gpt2(model) -> Tuple[CausalLMConfig, Any]:
     return cfg, params
 
 
+def _convert_gptneo(model) -> Tuple[CausalLMConfig, Any]:
+    """GPT-Neo (reference container ``module_inject/containers/gptneo.py:1``):
+    GPT-2-style learned positions with SEPARATE bias-free q/k/v projections
+    (torch ``nn.Linear``, not Conv1D — kernels transpose) and alternating
+    global/LOCAL attention. A local layer attends to the trailing
+    ``window_size`` tokens, which coincides with causal attention inside the
+    window, so ``max_seq_len`` is clamped to the window (the local-attention
+    layout trap; same treatment as the Mistral sliding-window clamp)."""
+    hf = model.config
+    max_len = hf.max_position_embeddings
+    if "local" in getattr(hf, "attention_layers", []):
+        window = int(hf.window_size)
+        if max_len > window:
+            logger.warning(
+                f"gpt-neo uses local attention with window {window}: serving "
+                f"clamps max_seq_len {max_len} -> {window} (beyond the window "
+                "local and causal attention diverge)")
+        max_len = min(max_len, window)
+    cfg = gpt2_cfg(vocab_size=hf.vocab_size, max_seq_len=max_len,
+                   n_embd=hf.hidden_size, n_layer=hf.num_layers,
+                   n_head=hf.num_heads,
+                   d_ff=hf.intermediate_size or 4 * hf.hidden_size,
+                   ln_eps=hf.layer_norm_epsilon, qkv_bias=False)
+    cfg.name = "gptneo"
+    act_map = {"gelu_new": "gelu", "gelu": "gelu", "gelu_fast": "gelu",
+               "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+    act = getattr(hf, "activation_function", "gelu_new")
+    if act not in act_map:
+        raise ValueError(
+            f"gpt-neo activation_function={act!r} has no CausalLM equivalent "
+            f"(supported: {sorted(act_map)})")
+    cfg.activation = act_map[act]
+    sd = model.state_dict()
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    params = {"wte": jnp.asarray(_np(sd[f"{pfx}wte.weight"])),
+              "wpe": jnp.asarray(_np(sd[f"{pfx}wpe.weight"])[:max_len]),
+              "ln_f": _ln(sd, f"{pfx}ln_f")}
+    if not getattr(hf, "tie_word_embeddings", True) and "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": _kernel(sd["lm_head.weight"])}
+        cfg.tie_word_embeddings = False
+    for i in range(cfg.n_layer):
+        lp = f"{pfx}h.{i}"
+        ap = f"{lp}.attn.attention"
+        params[f"layers_{i}"] = {
+            "ln_attn": _ln(sd, f"{lp}.ln_1"),
+            "ln_mlp": _ln(sd, f"{lp}.ln_2"),
+            # GPT-Neo applies NO 1/sqrt(d_head) attention scaling; folding
+            # sqrt(d_head) into the q kernel cancels this model's scaling exactly
+            "q_proj": {"kernel": _kernel(sd[f"{ap}.q_proj.weight"])
+                       * float(np.sqrt(cfg.head_dim))},
+            "k_proj": {"kernel": _kernel(sd[f"{ap}.k_proj.weight"])},
+            "v_proj": {"kernel": _kernel(sd[f"{ap}.v_proj.weight"])},
+            "o_proj": {"kernel": _kernel(sd[f"{ap}.out_proj.weight"]),
+                       "bias": _vec(sd[f"{ap}.out_proj.bias"])},
+            "fc_in": {"kernel": _kernel(sd[f"{lp}.mlp.c_fc.weight"]),
+                      "bias": _vec(sd[f"{lp}.mlp.c_fc.bias"])},
+            "fc_out": {"kernel": _kernel(sd[f"{lp}.mlp.c_proj.weight"]),
+                       "bias": _vec(sd[f"{lp}.mlp.c_proj.bias"])},
+        }
+    return cfg, params
+
+
 def _convert_bloom(model) -> Tuple[CausalLMConfig, Any]:
     hf = model.config
     cfg = bloom_cfg(vocab_size=hf.vocab_size, max_seq_len=2048,
@@ -334,6 +396,7 @@ def _convert_qwen2(model) -> Tuple[CausalLMConfig, Any]:
 
 HF_POLICIES: Dict[str, Callable] = {
     "gpt2": _convert_gpt2,
+    "gpt_neo": _convert_gptneo,
     "bloom": _convert_bloom,
     "opt": _convert_opt,
     "llama": _convert_llama,

@@ -179,11 +179,20 @@ class _NVMeMomentStore:
         for i, f in enumerate(self._files):
             src = os.path.join(src_dir, os.path.basename(f))
             if os.path.isfile(src):
-                shutil.copy2(src, f)
-                # migrate pre-O_DIRECT checkpoints: old files are exactly 2·s·4
-                # bytes; pad to the 4096-aligned IO length so direct reads succeed
+                # size gate BEFORE installing: the only accepted sizes are the
+                # padded IO length and the EXACT pre-O_DIRECT legacy length
+                # (2·s·4 bytes, padded below). Anything else is a truncated or
+                # corrupt moments file — restoring it would silently zero or
+                # garble optimizer state.
                 want = self._io_len(i) * 4
-                have = os.path.getsize(f)
+                legacy = 2 * self.sizes[i] * 4
+                have = os.path.getsize(src)
+                if have not in (want, legacy):
+                    raise RuntimeError(
+                        f"corrupt moments file {src}: {have} bytes, expected "
+                        f"{want} (or legacy {legacy}) — the checkpoint is "
+                        "damaged; restore from the previous 'latest' tag")
+                shutil.copy2(src, f)
                 if have < want:
                     with open(f, "ab") as fh:
                         fh.write(b"\0" * (want - have))

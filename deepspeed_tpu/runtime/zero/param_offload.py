@@ -138,7 +138,14 @@ class _NVMeParamTier:
         """Synchronous master read into the staging buffer (valid until the next
         push/read on this tier)."""
         s = self.sizes[i]
-        self.handle.sync_pread(self._pushbuf[:self._padded(s)], self._mfiles[i])
+        try:
+            self.handle.sync_pread(self._pushbuf[:self._padded(s)],
+                                   self._mfiles[i])
+        except OSError as e:
+            raise RuntimeError(
+                f"NVMe master read failed for leaf {i} ({self._mfiles[i]}): "
+                f"{e} — the swap file is truncated or unreadable; restart from "
+                "the last checkpoint") from e
         return self._pushbuf[:s]
 
     def read_masters_pipelined(self, indices):
@@ -207,8 +214,20 @@ class _NVMeParamTier:
     def copy_masters_from(self, src_dir: str):
         import os
         import shutil
-        for f in self._mfiles:
-            shutil.copy2(os.path.join(src_dir, os.path.basename(f)), f)
+        for i, f in enumerate(self._mfiles):
+            src = os.path.join(src_dir, os.path.basename(f))
+            want = self._padded(self.sizes[i]) * 4
+            if not os.path.isfile(src):
+                raise RuntimeError(
+                    f"missing master file {src} in checkpoint — the checkpoint "
+                    "is incomplete; restore from the previous 'latest' tag")
+            have = os.path.getsize(src)
+            if have != want:
+                raise RuntimeError(
+                    f"corrupt master file {src}: {have} bytes, expected {want} "
+                    "— the checkpoint is damaged; restore from the previous "
+                    "'latest' tag")
+            shutil.copy2(src, f)
 
 
 class ParamOffloadCoordinator:

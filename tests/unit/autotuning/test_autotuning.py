@@ -203,3 +203,38 @@ class TestEndToEnd:
         assert len(ok) >= 2
         assert all(r["throughput"] > 0 for r in ok)
         assert results["model_info"]["num_params"] == 544
+
+    def test_real_runner_subprocess(self, tmp_path):
+        """The REAL experiment runner (deepspeed_tpu.autotuning.runner): builds
+        an actual engine in the subprocess from the merged config's model block,
+        measures steps, and the tuner picks a winner from real measurements —
+        the reference's launch-a-training-job lane (autotuner.py:39)."""
+        base = {
+            "train_batch_size": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10**9,
+            "model": {"factory": "deepspeed_tpu.models:gpt2_model",
+                      "config_class": "deepspeed_tpu.models:GPT2Config",
+                      "config": {"vocab_size": 128, "n_positions": 32,
+                                 "n_embd": 32, "n_layer": 2, "n_head": 4,
+                                 "dropout": 0.0},
+                      "sample_seq_len": 32, "measure_steps": 2,
+                      "warmup_steps": 1},
+        }
+        at_cfg = AutotuningConfig(
+            enabled=True, results_dir=str(tmp_path), metric="throughput",
+            experiment_runner="deepspeed_tpu.autotuning.runner",
+            experiment_timeout_s=300, max_parallel_experiments=1,
+            min_train_micro_batch_size_per_gpu=4,
+            max_train_micro_batch_size_per_gpu=4,
+            tuning_space={"model.config.remat": [False, True]},
+            model_info={"num_params": 10000})
+        best = Autotuner(base, lambda o: (_ for _ in ()).throw(
+            AssertionError("in-process factory must not run")),
+            lambda bs: None, at_cfg).tune()
+        assert best is not None and "model.config.remat" in best
+        results = json.loads((tmp_path / "autotuning_results.json").read_text())
+        ok = [r for r in results["records"] if r["status"] == "ok"]
+        assert len(ok) == 2
+        assert all(r["throughput"] > 0 and r["loss"] == r["loss"] for r in ok)
