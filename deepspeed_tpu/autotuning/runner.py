@@ -66,8 +66,9 @@ def run_experiment(config: dict, overrides: dict) -> dict:
     batch = {"input_ids": rng.integers(0, vocab, size=(batch_size, seq),
                                        dtype=np.int32)}
 
-    warmup = int(model_spec.get("warmup_steps", 3))
-    steps = int(model_spec.get("measure_steps", 20))
+    # ≥1 warmup binds `loss` for the sync below; ≥1 measured step for dt/steps
+    warmup = max(1, int(model_spec.get("warmup_steps", 3)))
+    steps = max(1, int(model_spec.get("measure_steps", 20)))
     for _ in range(warmup):
         loss = engine.train_batch(batch)
     float(loss)                                   # sync: exclude compile/warmup

@@ -1,2 +1,3 @@
 from .config import DeepSpeedInferenceConfig, DeepSpeedTPConfig
 from .engine import InferenceEngine
+from .diffusion_engine import DiffusionInferenceEngine, init_diffusion_inference

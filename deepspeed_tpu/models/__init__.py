@@ -6,3 +6,5 @@ from .gpt2 import (GPT2, GPT2Config, GPT2_PRESETS, cross_entropy_loss, gpt2_conf
                    gpt2_model, gpt2_param_specs)
 from .gpt2_moe import GPT2MoE, GPT2MoEConfig, gpt2_moe_model, gpt2_moe_param_specs
 from .gpt2_pipe import gpt2_pipeline_module
+from .diffusion import (CLIPTextConfig, CLIPTextEncoder, UNet2DCondition,
+                        UNetConfig, VAEConfig, VAEDecoder)

@@ -1,2 +1,4 @@
 from .replace_module import (HF_POLICIES, convert_hf_model, convert_training_model,
                              replace_transformer_layer)
+from .diffusers_policies import (convert_clip_text, convert_unet_state_dict,
+                                 convert_vae_decoder_state_dict)

@@ -1,0 +1,155 @@
+"""Diffusers/CLIP weight injection — the ``generic_injection`` equivalent.
+
+The reference patches optimized containers into HF diffusers pipelines
+(``module_inject/replace_module.py:213`` ``generic_injection`` routing UNet/VAE/
+CLIP through ``containers/unet.py:1`` / ``vae.py:1`` / ``clip.py:1``). Here the
+flax modules in ``models/diffusion.py`` name every submodule after its diffusers
+state-dict path, so conversion is a NORMALIZED-NAME JOIN: both sides flatten to
+the same underscore string (torch ``down_blocks.0.attentions.0.transformer_blocks
+.0.attn1.to_q.weight`` ≡ flax path ``down_blocks_0_attentions_0 / transformer_
+blocks_0 / attn1 / to_q / kernel``), and each tensor converts by the abstract
+flax leaf: conv OIHW → HWIO, linear (O,I) → (I,O), norm weight → scale. Every
+unmatched or shape-mismatched tensor is reported — the conversion validates the
+format contract instead of trusting it.
+
+No dependency on the diffusers package: conversion consumes plain torch state
+dicts (synthesized in diffusers naming in tests; CLIP pinned against the real
+``transformers`` torch module).
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.diffusion import (CLIPTextConfig, UNet2DCondition, UNetConfig,
+                                VAEConfig, VAEDecoder)
+
+_LEAF_TO_TORCH = {"kernel": "weight", "scale": "weight"}
+
+
+def _np(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+def _index_abstract(abstract_params) -> Dict[str, Tuple[tuple, Any]]:
+    """{normalized torch-style name: (flax key path, abstract leaf)}."""
+    flat = jax.tree_util.tree_flatten_with_path(abstract_params)[0]
+    index = {}
+    for path, leaf in flat:
+        parts = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        torch_leaf = _LEAF_TO_TORCH.get(parts[-1], parts[-1])
+        index["_".join(parts[:-1] + [torch_leaf])] = (path, leaf)
+    return index
+
+
+def _convert_leaf(flax_name: str, abstract, arr: np.ndarray) -> np.ndarray:
+    if flax_name == "kernel":
+        if arr.ndim == 4:                      # conv OIHW → HWIO
+            arr = arr.transpose(2, 3, 1, 0)
+        elif arr.ndim == 2:                    # linear (O, I) → (I, O)
+            arr = arr.T
+    return arr
+
+
+def convert_to_flax(sd: Dict[str, Any], module, *sample_args,
+                    skip_prefixes: Tuple[str, ...] = ()) -> Any:
+    """Torch state dict → flax params for ``module`` (shape-validated)."""
+    abstract = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0), *sample_args))["params"]
+    index = _index_abstract(abstract)
+    filled: Dict[str, Any] = {}
+    unmatched, mismatched = [], []
+    for key, t in sd.items():
+        if any(key.startswith(p) for p in skip_prefixes):
+            continue
+        norm = key.replace(".", "_")
+        if norm not in index:
+            unmatched.append(key)
+            continue
+        path, leaf = index[norm]
+        flax_name = str(getattr(path[-1], "key", path[-1]))
+        arr = _convert_leaf(flax_name, leaf, _np(t))
+        if tuple(arr.shape) != tuple(leaf.shape):
+            mismatched.append((key, arr.shape, tuple(leaf.shape)))
+            continue
+        node = filled
+        for p in path[:-1]:
+            node = node.setdefault(str(getattr(p, "key", p)), {})
+        node[flax_name] = jnp.asarray(arr)
+    missing = [n for n in index
+               if n not in {k.replace(".", "_") for k in sd
+                            if not any(k.startswith(p) for p in skip_prefixes)}]
+    if unmatched or mismatched or missing:
+        raise ValueError(
+            "diffusers conversion failed the format contract:\n"
+            f"  unmatched torch keys: {sorted(unmatched)[:6]}\n"
+            f"  shape mismatches (key, got, want): {mismatched[:6]}\n"
+            f"  missing flax params: {sorted(missing)[:6]}")
+    return filled
+
+
+def convert_unet_state_dict(sd: Dict[str, Any], config: UNetConfig) -> Any:
+    """Diffusers ``UNet2DConditionModel`` state dict → flax params for
+    :class:`~.models.diffusion.UNet2DCondition` (reference
+    ``containers/unet.py:1``)."""
+    s = config.sample_size
+    sample = jnp.zeros((1, s, s, config.in_channels), jnp.float32)
+    t = jnp.zeros((1,), jnp.int32)
+    ctx = jnp.zeros((1, 8, config.cross_attention_dim), jnp.float32)
+    return convert_to_flax(sd, UNet2DCondition(config), sample, t, ctx)
+
+
+def convert_vae_decoder_state_dict(sd: Dict[str, Any],
+                                   config: VAEConfig) -> Any:
+    """Diffusers ``AutoencoderKL`` state dict (decoder half + post_quant_conv) →
+    flax params for :class:`~.models.diffusion.VAEDecoder`; encoder tensors are
+    skipped (reference ``containers/vae.py:1`` serves the same decode path)."""
+    z = jnp.zeros((1, 8, 8, config.latent_channels), jnp.float32)
+    return convert_to_flax(sd, VAEDecoder(config), z,
+                           skip_prefixes=("encoder.", "quant_conv"))
+
+
+def convert_clip_text(model) -> Tuple[CLIPTextConfig, Any]:
+    """HF torch ``CLIPTextModel`` → (config, flax params) for
+    :class:`~.models.diffusion.CLIPTextEncoder` (reference
+    ``containers/clip.py:1``). Output parity is pinned in
+    ``tests/unit/inference/test_diffusion.py``."""
+    hf = model.config
+    cfg = CLIPTextConfig(
+        vocab_size=hf.vocab_size,
+        max_position_embeddings=hf.max_position_embeddings,
+        hidden_size=hf.hidden_size, num_hidden_layers=hf.num_hidden_layers,
+        num_attention_heads=hf.num_attention_heads,
+        intermediate_size=hf.intermediate_size,
+        ln_eps=getattr(hf, "layer_norm_eps", 1e-5))
+    sd = model.state_dict()
+    pfx = "text_model." if any(k.startswith("text_model.") for k in sd) else ""
+
+    def g(key):
+        return jnp.asarray(_np(sd[pfx + key]))
+
+    params: Dict[str, Any] = {
+        "token_embedding": g("embeddings.token_embedding.weight"),
+        "position_embedding": g("embeddings.position_embedding.weight"),
+        "final_layer_norm": {"scale": g("final_layer_norm.weight"),
+                             "bias": g("final_layer_norm.bias")},
+    }
+    for i in range(cfg.num_hidden_layers):
+        lp = f"encoder.layers.{i}"
+        for ours, theirs in (
+                (f"layers_{i}_layer_norm1", f"{lp}.layer_norm1"),
+                (f"layers_{i}_layer_norm2", f"{lp}.layer_norm2")):
+            params[ours] = {"scale": g(f"{theirs}.weight"),
+                            "bias": g(f"{theirs}.bias")}
+        for ours, theirs in (
+                (f"layers_{i}_q_proj", f"{lp}.self_attn.q_proj"),
+                (f"layers_{i}_k_proj", f"{lp}.self_attn.k_proj"),
+                (f"layers_{i}_v_proj", f"{lp}.self_attn.v_proj"),
+                (f"layers_{i}_out_proj", f"{lp}.self_attn.out_proj"),
+                (f"layers_{i}_fc1", f"{lp}.mlp.fc1"),
+                (f"layers_{i}_fc2", f"{lp}.mlp.fc2")):
+            params[ours] = {"kernel": g(f"{theirs}.weight").T,
+                            "bias": g(f"{theirs}.bias")}
+    return cfg, params
