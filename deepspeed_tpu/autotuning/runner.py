@@ -90,6 +90,16 @@ def run_experiment(config: dict, overrides: dict) -> dict:
 
 
 def main():
+    import os
+
+    # honor the caller's platform choice even under site hooks that pin another
+    # platform regardless of JAX_PLATFORMS (config.update after import is the
+    # only reliable override — same recipe as tests/conftest.py)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and "," not in plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
     p = argparse.ArgumentParser()
     p.add_argument("--config", required=True)
     p.add_argument("--overrides", required=True)

@@ -100,9 +100,10 @@ class DiffusionInferenceEngine:
     def _build(self, steps: int):
         cfg = self.unet_config
         alphas = self.alphas_cumprod
-        # DDIM timestep subsequence (trailing spacing, as diffusers DDIMScheduler)
+        # DDIM timestep subsequence: LEADING spacing + steps_offset=1 — the
+        # SD-1.x DDIMScheduler configuration ([981, 961, ..., 1] at 50 steps)
         step_idx = (jnp.arange(steps, dtype=jnp.int32)[::-1] *
-                    (self.num_train_timesteps // steps))
+                    (self.num_train_timesteps // steps)) + 1
 
         def run(params, prompt_ids, negative_ids, guidance, rng):
             text = self.clip.apply({"params": params["clip"]}, prompt_ids)
@@ -138,7 +139,13 @@ class DiffusionInferenceEngine:
     def generate(self, prompt_ids, negative_ids=None, steps: int = 50,
                  guidance_scale: float = 7.5,
                  seed: int = 0) -> np.ndarray:
-        """(b, 77) int32 token ids → (b, H, W, 3) float images in [0, 1]."""
+        """(b, 77) int32 token ids → (b, H, W, 3) float images in [0, 1].
+
+        For diffusers-equivalent classifier-free guidance, pass the TOKENIZED
+        empty prompt (BOS + EOS + padding per your tokenizer) as
+        ``negative_ids`` — token ids are tokenizer-specific, so this engine
+        cannot synthesize them. The all-zeros default is a placeholder
+        unconditional sequence, not the empty-prompt encoding."""
         prompt_ids = jnp.asarray(np.asarray(prompt_ids), jnp.int32)
         if negative_ids is None:
             negative_ids = jnp.zeros_like(prompt_ids)

@@ -36,6 +36,7 @@ class UNetConfig:
     cross_attention_dim: int = 768
     attention_head_dim: int = 8            # heads; head_dim = C // heads
     norm_num_groups: int = 32
+    norm_eps: float = 1e-5                 # diffusers UNet2DConditionModel norm_eps
     dtype: Any = jnp.bfloat16
 
     @property
@@ -50,6 +51,7 @@ class VAEConfig:
     block_out_channels: Tuple[int, ...] = (128, 256, 512, 512)
     layers_per_block: int = 2              # decoder uses layers_per_block + 1
     norm_num_groups: int = 32
+    norm_eps: float = 1e-6                 # diffusers AutoencoderKL norm eps
     scaling_factor: float = 0.18215
     dtype: Any = jnp.bfloat16
 
@@ -63,14 +65,16 @@ class CLIPTextConfig:
     num_attention_heads: int = 12
     intermediate_size: int = 3072
     ln_eps: float = 1e-5
+    act: str = "quick_gelu"                # HF hidden_act: quick_gelu (SD-1.x) | gelu
     dtype: Any = jnp.bfloat16
 
 
 # ----------------------------------------------------------------------- primitives
-def _gn(groups, name):
+def _gn(groups, name, eps):
     # GroupNorms stay fp32 regardless of the compute dtype (same policy as the
-    # fp32 LayerNorms in the text/decoder stacks)
-    return nn.GroupNorm(num_groups=groups, epsilon=1e-6, name=name,
+    # fp32 LayerNorms in the text/decoder stacks); eps follows the source model
+    # (diffusers UNet 1e-5, VAE 1e-6)
+    return nn.GroupNorm(num_groups=groups, epsilon=eps, name=name,
                         dtype=jnp.float32)
 
 
@@ -123,7 +127,8 @@ class _Attention(nn.Module):
 
 
 class _FeedForward(nn.Module):
-    """GEGLU feed-forward (diffusers ``ff.net.0.proj`` + ``ff.net.2``)."""
+    """GEGLU feed-forward (diffusers ``ff.net.0.proj`` + ``ff.net.2``); gate
+    uses EXACT (erf) gelu like torch ``F.gelu`` in diffusers' GEGLU."""
     dim: int
     dtype: Any = jnp.bfloat16
 
@@ -132,12 +137,12 @@ class _FeedForward(nn.Module):
         h = nn.Dense(8 * self.dim, dtype=self.dtype, name="net_0_proj")(x)
         a, g = jnp.split(h, 2, axis=-1)
         return nn.Dense(self.dim, dtype=self.dtype, name="net_2")(
-            a * nn.gelu(g))
+            a * nn.gelu(g, approximate=False))
 
 
 class _BasicTransformerBlock(nn.Module):
     """LN → self-attn → LN → cross-attn → LN → GEGLU FF (diffusers
-    ``BasicTransformerBlock``)."""
+    ``BasicTransformerBlock``; LayerNorm eps 1e-5 = torch default)."""
     heads: int
     dim: int
     context_dim: int
@@ -145,13 +150,15 @@ class _BasicTransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, context):
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
+        def ln(name):
+            return nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name=name)
+        h = ln("norm1")(x).astype(self.dtype)
         x = x + _Attention(self.heads, self.dim, dtype=self.dtype,
                            name="attn1")(h)
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x).astype(self.dtype)
+        h = ln("norm2")(x).astype(self.dtype)
         x = x + _Attention(self.heads, self.dim,
                            dtype=self.dtype, name="attn2")(h, context)
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
+        h = ln("norm3")(x).astype(self.dtype)
         return x + _FeedForward(self.dim, dtype=self.dtype, name="ff")(h)
 
 
@@ -162,13 +169,14 @@ class _Transformer2D(nn.Module):
     dim: int
     context_dim: int
     groups: int
+    eps: float = 1e-5
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, context):
         b, hh, ww, c = x.shape
         res = x
-        h = _gn(self.groups, "norm")(x).astype(self.dtype)
+        h = _gn(self.groups, "norm", self.eps)(x).astype(self.dtype)
         h = _conv(self.dim, 1, "proj_in", self.dtype, pad=0)(h)
         h = h.reshape(b, hh * ww, self.dim)
         h = _BasicTransformerBlock(self.heads, self.dim, self.context_dim,
@@ -185,18 +193,19 @@ class _ResnetBlock(nn.Module):
     out_ch: int
     groups: int
     time_dim: Optional[int] = None
+    eps: float = 1e-5
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x, temb=None):
         in_ch = x.shape[-1]
-        h = _gn(self.groups, "norm1")(x).astype(self.dtype)
+        h = _gn(self.groups, "norm1", self.eps)(x).astype(self.dtype)
         h = _conv(self.out_ch, 3, "conv1", self.dtype)(nn.silu(h))
         if temb is not None:
             t = nn.Dense(self.out_ch, dtype=self.dtype,
                          name="time_emb_proj")(nn.silu(temb))
             h = h + t[:, None, None, :]
-        h = _gn(self.groups, "norm2")(h).astype(self.dtype)
+        h = _gn(self.groups, "norm2", self.eps)(h).astype(self.dtype)
         h = _conv(self.out_ch, 3, "conv2", self.dtype)(nn.silu(h))
         if in_ch != self.out_ch:
             x = _conv(self.out_ch, 1, "conv_shortcut", self.dtype, pad=0)(x)
@@ -232,11 +241,11 @@ class UNet2DCondition(nn.Module):
         for bi, ch in enumerate(chs):
             attn = bi < len(chs) - 1
             for li in range(cfg.layers_per_block):
-                h = _ResnetBlock(ch, groups, tdim, dtype=dt,
+                h = _ResnetBlock(ch, groups, tdim, eps=cfg.norm_eps, dtype=dt,
                                  name=f"down_blocks_{bi}_resnets_{li}")(h, temb)
                 if attn:
                     h = _Transformer2D(heads, ch, cfg.cross_attention_dim,
-                                       groups, dtype=dt,
+                                       groups, eps=cfg.norm_eps, dtype=dt,
                                        name=f"down_blocks_{bi}_attentions_{li}"
                                        )(h, ctx)
                 skips.append(h)
@@ -245,11 +254,11 @@ class UNet2DCondition(nn.Module):
                           stride=2)(h)
                 skips.append(h)
 
-        h = _ResnetBlock(chs[-1], groups, tdim, dtype=dt,
+        h = _ResnetBlock(chs[-1], groups, tdim, eps=cfg.norm_eps, dtype=dt,
                          name="mid_block_resnets_0")(h, temb)
         h = _Transformer2D(heads, chs[-1], cfg.cross_attention_dim, groups,
-                           dtype=dt, name="mid_block_attentions_0")(h, ctx)
-        h = _ResnetBlock(chs[-1], groups, tdim, dtype=dt,
+                           eps=cfg.norm_eps, dtype=dt, name="mid_block_attentions_0")(h, ctx)
+        h = _ResnetBlock(chs[-1], groups, tdim, eps=cfg.norm_eps, dtype=dt,
                          name="mid_block_resnets_1")(h, temb)
 
         # up: reversed channels; each block consumes layers_per_block+1 skips
@@ -257,11 +266,11 @@ class UNet2DCondition(nn.Module):
             attn = bi > 0
             for li in range(cfg.layers_per_block + 1):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
-                h = _ResnetBlock(ch, groups, tdim, dtype=dt,
+                h = _ResnetBlock(ch, groups, tdim, eps=cfg.norm_eps, dtype=dt,
                                  name=f"up_blocks_{bi}_resnets_{li}")(h, temb)
                 if attn:
                     h = _Transformer2D(heads, ch, cfg.cross_attention_dim,
-                                       groups, dtype=dt,
+                                       groups, eps=cfg.norm_eps, dtype=dt,
                                        name=f"up_blocks_{bi}_attentions_{li}"
                                        )(h, ctx)
             if bi < len(chs) - 1:
@@ -269,7 +278,7 @@ class UNet2DCondition(nn.Module):
                 h = jax.image.resize(h, (b, 2 * hh, 2 * ww, c), "nearest")
                 h = _conv(c, 3, f"up_blocks_{bi}_upsamplers_0_conv", dt)(h)
 
-        h = _gn(groups, "conv_norm_out")(h).astype(dt)
+        h = _gn(groups, "conv_norm_out", cfg.norm_eps)(h).astype(dt)
         return _conv(self.config.out_channels, 3, "conv_out", dt)(
             nn.silu(h)).astype(jnp.float32)
 
@@ -289,28 +298,29 @@ class VAEDecoder(nn.Module):
         z = _conv(cfg.latent_channels, 1, "post_quant_conv", dt, pad=0)(
             z.astype(dt))
         h = _conv(chs[-1], 3, "decoder_conv_in", dt)(z)
-        h = _ResnetBlock(chs[-1], groups, dtype=dt,
+        h = _ResnetBlock(chs[-1], groups, eps=cfg.norm_eps, dtype=dt,
                          name="decoder_mid_block_resnets_0")(h)
         # single-head spatial attention mid-block (diffusers ``Attention`` with
         # heads=1 inside the VAE)
         b, hh, ww, c = h.shape
-        hn = _gn(groups, "decoder_mid_block_attentions_0_group_norm")(h)
+        hn = _gn(groups, "decoder_mid_block_attentions_0_group_norm",
+                 cfg.norm_eps)(h)
         o = _Attention(1, c, dtype=dt,
                        name="decoder_mid_block_attentions_0")(
                            hn.astype(dt).reshape(b, hh * ww, c))
         h = h + o.reshape(b, hh, ww, c)
-        h = _ResnetBlock(chs[-1], groups, dtype=dt,
+        h = _ResnetBlock(chs[-1], groups, eps=cfg.norm_eps, dtype=dt,
                          name="decoder_mid_block_resnets_1")(h)
         for bi, ch in enumerate(reversed(chs)):
             for li in range(cfg.layers_per_block + 1):
-                h = _ResnetBlock(ch, groups, dtype=dt,
+                h = _ResnetBlock(ch, groups, eps=cfg.norm_eps, dtype=dt,
                                  name=f"decoder_up_blocks_{bi}_resnets_{li}")(h)
             if bi < len(chs) - 1:
                 b, hh, ww, c = h.shape
                 h = jax.image.resize(h, (b, 2 * hh, 2 * ww, c), "nearest")
                 h = _conv(c, 3, f"decoder_up_blocks_{bi}_upsamplers_0_conv",
                           dt)(h)
-        h = _gn(groups, "decoder_conv_norm_out")(h).astype(dt)
+        h = _gn(groups, "decoder_conv_norm_out", cfg.norm_eps)(h).astype(dt)
         return _conv(cfg.out_channels, 3, "decoder_conv_out", dt)(
             nn.silu(h)).astype(jnp.float32)
 
@@ -361,7 +371,10 @@ class CLIPTextEncoder(nn.Module):
                              name=f"{pfx}_layer_norm2")(x).astype(dt)
             h = nn.Dense(cfg.intermediate_size, dtype=dt,
                          name=f"{pfx}_fc1")(h)
-            h = h * jax.nn.sigmoid(1.702 * h)          # CLIP quick-gelu
+            if cfg.act == "quick_gelu":
+                h = h * jax.nn.sigmoid(1.702 * h)      # CLIP quick-gelu
+            else:
+                h = nn.gelu(h, approximate=False)      # SD-2.x text encoders
             x = x + nn.Dense(cfg.hidden_size, dtype=dt, name=f"{pfx}_fc2")(h)
         return nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32,
                             name="final_layer_norm")(x)

@@ -78,9 +78,9 @@ def convert_to_flax(sd: Dict[str, Any], module, *sample_args,
         for p in path[:-1]:
             node = node.setdefault(str(getattr(p, "key", p)), {})
         node[flax_name] = jnp.asarray(arr)
-    missing = [n for n in index
-               if n not in {k.replace(".", "_") for k in sd
-                            if not any(k.startswith(p) for p in skip_prefixes)}]
+    seen = {k.replace(".", "_") for k in sd
+            if not any(k.startswith(p) for p in skip_prefixes)}
+    missing = [n for n in index if n not in seen]
     if unmatched or mismatched or missing:
         raise ValueError(
             "diffusers conversion failed the format contract:\n"
@@ -124,6 +124,11 @@ def convert_clip_text(model) -> Tuple[CLIPTextConfig, Any]:
         num_attention_heads=hf.num_attention_heads,
         intermediate_size=hf.intermediate_size,
         ln_eps=getattr(hf, "layer_norm_eps", 1e-5))
+    act = getattr(hf, "hidden_act", "quick_gelu")
+    if act not in ("quick_gelu", "gelu"):
+        raise ValueError(f"CLIP hidden_act={act!r} unsupported "
+                         "(quick_gelu and gelu are wired)")
+    cfg.act = act
     sd = model.state_dict()
     pfx = "text_model." if any(k.startswith("text_model.") for k in sd) else ""
 

@@ -108,9 +108,11 @@ def _fwd_kernel(*refs, scale, causal, use_alibi, nk, bq, bk, t_valid):
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                       # (bq, d)
-        k_blk = k_ref[0].astype(jnp.float32)                   # (bk, d)
-        v_blk = v_ref[0].astype(jnp.float32)
+        # matmuls take the INPUT dtype (bf16 inputs hit the MXU's native rate —
+        # an f32 upcast here would halve matmul throughput) and accumulate f32
+        q = q_ref[0]                                           # (bq, d)
+        k_blk = k_ref[0]                                       # (bk, d)
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         rows = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -130,7 +132,7 @@ def _fwd_kernel(*refs, scale, causal, use_alibi, nk, bq, bk, t_valid):
         p = jnp.exp(s - m_new[:, None])
         l_new = l_scr[0][0] * alpha + jnp.sum(p, axis=-1)
         acc_scr[...] = acc_scr[...] * alpha[None, :, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)[None]
         m_scr[...] = jnp.broadcast_to(m_new[None, None, :], m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new[None, None, :], l_scr.shape)
@@ -214,12 +216,15 @@ def _bwd_dq_kernel(*refs, scale, causal, use_alibi, nk, bq, bk, t_valid):
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # input-dtype matmuls, f32 accumulation (same policy as the forward —
+        # bf16 inputs keep the MXU at its native rate AND make the recomputed s
+        # bit-identical to the s the forward derived lse from)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0, 0]
         delta = delta_ref[0, 0, 0]
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         rows = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -233,7 +238,7 @@ def _bwd_dq_kernel(*refs, scale, causal, use_alibi, nk, bq, bk, t_valid):
         p = jnp.exp(s - lse[:, None])                      # true probs
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(k_blk.dtype)
         dq_scr[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)[None]
@@ -265,10 +270,11 @@ def _bwd_dkv_kernel(*refs, scale, causal, use_alibi, nq, bq, bk, t_valid):
 
     @pl.when(active)
     def _compute():
-        k_blk = k_ref[0].astype(jnp.float32)      # (bk, d)
-        v_blk = v_ref[0].astype(jnp.float32)
-        q_blk = q_ref[0].astype(jnp.float32)      # (bq, d)
-        do_blk = do_ref[0].astype(jnp.float32)
+        # input-dtype matmuls, f32 accumulation (see _bwd_dq_kernel)
+        k_blk = k_ref[0]                          # (bk, d)
+        v_blk = v_ref[0]
+        q_blk = q_ref[0]                          # (bq, d)
+        do_blk = do_ref[0]
         lse_blk = lse_ref[0, 0, 0]                # (bq,)
         delta_blk = delta_ref[0, 0, 0]
         s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
@@ -283,11 +289,11 @@ def _bwd_dkv_kernel(*refs, scale, causal, use_alibi, nq, bq, bk, t_valid):
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_blk[:, None])
         dv_scr[...] += jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)[None]
         dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None]) * scale
+        ds = (p * (dp - delta_blk[:, None]) * scale).astype(q_blk.dtype)
         dk_scr[...] += jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)[None]

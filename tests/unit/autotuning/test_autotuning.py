@@ -210,7 +210,9 @@ class TestEndToEnd:
         measures steps, and the tuner picks a winner from real measurements —
         the reference's launch-a-training-job lane (autotuner.py:39)."""
         base = {
-            "train_batch_size": 4,
+            # divisible for both 1 real device and the 8-device CPU-mesh flag
+            # the runner child inherits (micro 4 × dp {1,8} | 32)
+            "train_batch_size": 32,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
             "zero_optimization": {"stage": 1},
             "steps_per_print": 10**9,

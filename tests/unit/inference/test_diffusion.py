@@ -187,6 +187,28 @@ class TestCLIPParity:
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
 
 
+    def test_clip_gelu_act_matches_hf(self):
+        """SD-2.x-style text encoders use hidden_act='gelu' — the converted
+        module must follow the config, not hardcode quick-gelu."""
+        from deepspeed_tpu.module_inject.diffusers_policies import \
+            convert_clip_text
+        cfg = transformers.CLIPTextConfig(
+            vocab_size=99, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=16, hidden_act="gelu")
+        m = transformers.CLIPTextModel(cfg)
+        m.eval()
+        ours_cfg, params = convert_clip_text(m)
+        assert ours_cfg.act == "gelu"
+        ours_cfg.dtype = jnp.float32
+        ids = np.random.RandomState(3).randint(0, 99, size=(2, 12))
+        ours = CLIPTextEncoder(ours_cfg).apply({"params": params},
+                                               jnp.asarray(ids, jnp.int32))
+        with torch.no_grad():
+            ref = m(input_ids=torch.tensor(ids)).last_hidden_state.numpy()
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
+
+
 class TestConversionContract:
     def test_unet_converts_and_runs(self):
         from deepspeed_tpu.module_inject.diffusers_policies import \
