@@ -24,12 +24,6 @@ class EncoderInferenceEngine:
                  mesh_spec: Optional[MeshSpec] = None, seed: int = 0):
         from .config import DeepSpeedInferenceConfig
         self._config = config or DeepSpeedInferenceConfig()
-        if self._config.is_int8():
-            raise NotImplementedError(
-                "int8 serving (dtype='int8' or quant.enabled) is not wired "
-                "into EncoderInferenceEngine (the decoder InferenceEngine has "
-                "it). Use dtype='bf16' for encoders, or serve through the "
-                "decoder engine's quantized path.")
         tp = self._config.resolved_tp()
         dp = max(1, int(self._config.data_parallel))
         self.mesh_spec = mesh_spec or MeshSpec(
@@ -55,22 +49,51 @@ class EncoderInferenceEngine:
                  f"params≈{self.model_config.num_params():,} tp={tp} dp={dp} "
                  f"dtype={self.dtype.__name__}", ranks=[0])
 
+    # matmul weights eligible for int8 (same set/shape policy as the decoder's
+    # GroupQuantizer analogue; embeddings, norms, pooler stay in fp)
+    _QUANT_NAMES = ("q_proj", "k_proj", "v_proj", "o_proj", "fc_in", "fc_out")
+
     def _place_params(self, raw):
         from .engine import spec_fits
         specs = encoder_param_specs(raw, tensor_axis=AXIS_TENSOR)
         mesh = self.mesh_spec
+        int8 = self._config.is_int8()
+        if int8:
+            from ..ops.quantizer import validate_quant_config
+            validate_quant_config(self._config.quant)
+        self._quantized = int8
 
         def put(arr, spec):
-            arr = jnp.asarray(arr)
-            if arr.ndim >= 2 and arr.dtype in (jnp.float32, jnp.float16,
-                                               jnp.bfloat16):
-                arr = arr.astype(self.dtype)
             if not spec_fits(mesh, arr.shape, spec):
                 spec = P(*([None] * arr.ndim))
             return jax.device_put(arr, NamedSharding(mesh.mesh, spec))
 
-        return jax.tree_util.tree_map(put, raw, specs,
-                                      is_leaf=lambda x: not isinstance(x, dict))
+        def walk(node, spec_node, path):
+            if isinstance(node, dict):
+                return {k: walk(v, spec_node[k], path + (k,))
+                        for k, v in node.items()}
+            arr = jnp.asarray(node)
+            if arr.ndim >= 2 and arr.dtype in (jnp.float32, jnp.float16,
+                                               jnp.bfloat16):
+                arr = arr.astype(self.dtype)
+            if int8 and arr.ndim == 2 and path[-1] == "kernel" \
+                    and set(path) & set(self._QUANT_NAMES):
+                from ..ops.quantizer import (INT8_Q, INT8_SCALE,
+                                             quantize_grouped)
+                q, scale = quantize_grouped(arr)
+                spec_t = tuple(spec_node)
+                return {INT8_Q: put(q, P(*spec_t)),
+                        INT8_SCALE: put(scale.astype(jnp.float32),
+                                        P(*spec_t))}
+            return put(arr, spec_node)
+
+        return walk(raw, specs, ())
+
+    def _dequant(self, params):
+        if not getattr(self, "_quantized", False):
+            return params
+        from ..ops.quantizer import dequantize_tree
+        return dequantize_tree(params, self.dtype)
 
     def forward(self, input_ids, attention_mask=None, token_type_ids=None,
                 **kwargs):
@@ -79,7 +102,8 @@ class EncoderInferenceEngine:
         if "fwd" not in self._fns:
             self._fns["fwd"] = jax.jit(
                 lambda p, ids, am, tt: self.module.apply(
-                    {"params": p}, ids, attention_mask=am, token_type_ids=tt))
+                    {"params": self._dequant(p)}, ids, attention_mask=am,
+                    token_type_ids=tt))
         ids = jnp.asarray(np.asarray(input_ids))
         am = None if attention_mask is None else \
             jnp.asarray(np.asarray(attention_mask))

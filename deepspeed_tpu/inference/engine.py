@@ -136,6 +136,9 @@ class InferenceEngine:
         specs = causal_lm_param_specs(raw, tensor_axis=AXIS_TENSOR)
         mesh = self.mesh_spec
         int8 = self._config.is_int8()
+        if int8:
+            from ..ops.quantizer import validate_quant_config
+            validate_quant_config(self._config.quant)
         self._raw_template = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), getattr(x, "dtype", np.float32)),
             raw)
@@ -173,21 +176,10 @@ class InferenceEngine:
         return placed
 
     def _dequant(self, params):
-        """Collapse int8 nodes to fp weights inside a traced computation (XLA fuses the
-        dequant into the consuming matmul's operand read)."""
         if not getattr(self, "_quantized", False):
             return params
-
-        def walk(node):
-            if isinstance(node, dict):
-                if "__int8_q__" in node:
-                    from ..ops.quantizer import dequantize_grouped
-                    return dequantize_grouped(
-                        node["__int8_q__"], node["__int8_scale__"]).astype(self.dtype)
-                return {k: walk(v) for k, v in node.items()}
-            return node
-
-        return walk(params)
+        from ..ops.quantizer import dequantize_tree
+        return dequantize_tree(params, self.dtype)
 
     # ------------------------------------------------------------------ compiled steps
     def _build_fns(self):

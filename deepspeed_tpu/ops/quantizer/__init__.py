@@ -1,1 +1,2 @@
-from .quant import DEFAULT_GROUP, dequantize_grouped, quantize_grouped
+from .quant import (DEFAULT_GROUP, INT8_Q, INT8_SCALE, dequantize_grouped,
+                    dequantize_tree, quantize_grouped, validate_quant_config)

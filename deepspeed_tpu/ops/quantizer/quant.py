@@ -47,3 +47,34 @@ def dequantize_grouped(q, scales) -> jnp.ndarray:
     lead = q.shape[:-2]
     wg = q.reshape(*lead, groups, g, n).astype(jnp.float32)
     return (wg * scales[..., :, None, :]).reshape(*lead, k, n)
+
+
+# --------------------------------------------------------- engine tree helpers
+INT8_Q = "__int8_q__"
+INT8_SCALE = "__int8_scale__"
+
+
+def validate_quant_config(quant_cfg) -> None:
+    """Serving engines support 8-bit grouped quantization only — reject other
+    widths loudly instead of silently serving 8-bit (``QuantConfig.bits``)."""
+    bits = getattr(quant_cfg, "bits", 8)
+    if getattr(quant_cfg, "enabled", False) and bits != 8:
+        raise NotImplementedError(
+            f"quant.bits={bits} requested but only 8-bit grouped weight "
+            "quantization is wired (reference GroupQuantizer is 8-bit too)")
+
+
+def dequantize_tree(params, dtype):
+    """Collapse ``{__int8_q__, __int8_scale__}`` nodes to fp weights inside a
+    traced computation (XLA fuses the dequant into the consuming matmul's
+    operand read). Shared by the decoder and encoder inference engines so the
+    int8 node contract cannot drift between them."""
+    def walk(node):
+        if isinstance(node, dict):
+            if INT8_Q in node:
+                return dequantize_grouped(
+                    node[INT8_Q], node[INT8_SCALE]).astype(dtype)
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)

@@ -104,3 +104,28 @@ class TestBertParity:
         assert hidden.shape == (2, 12, 32)
         assert pooled.shape == (2, 32)
         assert np.isfinite(np.asarray(hidden)).all()
+
+
+class TestEncoderInt8:
+    def test_int8_close_to_fp_and_sharded(self, eight_devices):
+        """dtype='int8': encoder matmul weights grouped-quantized at load (same
+        GroupQuantizer analogue as the decoder engine), outputs close to fp,
+        and the int8 payloads shard over the tensor axis at tp=2."""
+        import jax.numpy as jnp
+        m = _bert()
+        ids, mask = _ids(seed=5)
+        e_fp = ds.init_inference(model=m, config={"dtype": "float32"})
+        h_fp, _ = e_fp.forward(ids, attention_mask=mask)
+
+        e_q = ds.init_inference(model=m, config={
+            "dtype": "int8", "tensor_parallel": {"tp_size": 2}})
+        qnode = e_q.params["layers_0"]["q_proj"]["kernel"]
+        assert isinstance(qnode, dict) and qnode["__int8_q__"].dtype == jnp.int8
+        assert "tensor" in str(qnode["__int8_q__"].sharding.spec)
+        h_q, _ = e_q.forward(ids, attention_mask=mask)
+
+        valid = mask.astype(bool)
+        a = np.asarray(h_fp)[valid]
+        b = np.asarray(h_q)[valid]
+        err = np.abs(b - a).mean() / (np.abs(a).mean() + 1e-9)
+        assert err < 0.05, f"relative int8 error {err:.4f} too large"
