@@ -7,6 +7,7 @@ Reference surface: ``ops/transformer/inference/moe_inference.py`` (MoE decode pa
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.inference.engine import InferenceEngine
@@ -29,9 +30,11 @@ def _greedy_rollout(apply_fn, params, ids, steps):
     return cur
 
 
-def test_serve_trained_moe_model():
+@pytest.mark.parametrize("decode_impl", ["pallas", "xla"])
+def test_serve_trained_moe_model(decode_impl):
     """gpt2_moe training params convert and serve through InferenceEngine: the cached MoE
-    decode path reproduces the training model's greedy rollout."""
+    decode fast path (both the gather-fused kernel and the XLA-gather fallback)
+    reproduces the training model's greedy rollout."""
     # eval_capacity_factor high enough that the training model's eval path provably drops
     # nothing — serving routes ALL tokens (no capacity, like the reference's inference
     # MoE), so exact parity requires a drop-free training reference
@@ -43,6 +46,7 @@ def test_serve_trained_moe_model():
 
     engine = InferenceEngine((cfg, params), ds.inference.DeepSpeedInferenceConfig(
         dtype="float32", max_out_tokens=64))
+    engine.model_config.moe_decode_impl = decode_impl
     assert engine.model_config.num_experts == 4
 
     rng = np.random.default_rng(0)
