@@ -317,6 +317,7 @@ def consolidate_partitioned_checkpoint(ckpt_dir: str, tag: str, save_dir: str,
     v_full: Dict[str, np.ndarray] = {}
     step = 0
     meta0 = None
+    seen_ranks: Dict[int, str] = {}
     for f in files:
         with np.load(f) as data:
             if "meta_json" not in data:
@@ -331,6 +332,16 @@ def consolidate_partitioned_checkpoint(ckpt_dir: str, tag: str, save_dir: str,
                     f"found {len(files)} partition files but the run had "
                     f"{meta['n_ranks']} ranks — a missing rank file would "
                     "leave its shards uninitialized in the consolidation")
+            # rank-SET validation (not just a count): a duplicated rank file
+            # (stale copy, botched rsync) passes the count check but leaves the
+            # missing rank's np.empty slices as garbage in the merged leaves
+            rank = int(meta.get("rank", -1))
+            if rank in seen_ranks:
+                raise ValueError(
+                    f"duplicate rank {rank} partition files: "
+                    f"{seen_ranks[rank]} and {f} both claim rank {rank} — the "
+                    f"rank set must be exactly 0..{meta['n_ranks'] - 1}")
+            seen_ranks[rank] = f
             if meta["nvme_params"]:
                 raise NotImplementedError(
                     "consolidating an NVMe-partitioned run: masters live in the "
@@ -360,6 +371,13 @@ def consolidate_partitioned_checkpoint(ckpt_dir: str, tag: str, save_dir: str,
                     v_full[name][sl] = np.asarray(data[f"v_{i}"],
                                                   np.float32).reshape(sshape)
 
+    expected_ranks = set(range(meta0["n_ranks"]))
+    if set(seen_ranks) != expected_ranks:
+        missing_ranks = sorted(expected_ranks - set(seen_ranks))
+        raise ValueError(
+            f"partition rank set {sorted(seen_ranks)} != expected "
+            f"{sorted(expected_ranks)} (missing ranks {missing_ranks}) — "
+            "consolidating would leave their master shards uninitialized")
     expected = {n for k, names in meta0["leaf_names"].items() for n in names}
     missing = expected - set(full)
     if missing:

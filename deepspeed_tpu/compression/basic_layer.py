@@ -12,7 +12,6 @@ All transforms accept traced step-dependent arguments (e.g. annealed ``bits``), 
 compression schedule runs inside the compiled train step without recompilation.
 """
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -20,7 +19,7 @@ import jax.numpy as jnp
 
 
 # --------------------------------------------------------------------- quantization
-@partial(jax.custom_vjp, nondiff_argnames=())
+@jax.custom_vjp
 def _ste(x, qx):
     """Forward: quantized value; backward: identity to x (straight-through)."""
     return qx

@@ -3,6 +3,6 @@ co-design so jobs scale across a precomputed set of world sizes without converge
 impact, plus the watchdog/restart agent."""
 from .config import (ElasticityConfig, ElasticityConfigError, ElasticityError,
                      ElasticityIncompatibleWorldSize)
-from .elastic_agent import DSElasticAgent
+from .elastic_agent import DSElasticAgent, TrainingWedgedError
 from .elasticity import (compute_elastic_config, elasticity_enabled,
                          ensure_immutable_elastic_config)

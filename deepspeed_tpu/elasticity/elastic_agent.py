@@ -32,22 +32,37 @@ from .elasticity import compute_elastic_config
 WATCHDOG_EXIT_CODE = 99
 
 
+class TrainingWedgedError(RuntimeError):
+    """The training loop stopped heartbeating: raised (in the main thread) after
+    the watchdog's best-effort checkpoint, so the launcher's restart policy —
+    not a silent in-process abort — decides what happens next."""
+
+
 class DSElasticAgent:
     """Watchdog + resume coordinator around a training loop."""
 
     def __init__(self, ds_config: Dict, world_size: Optional[int] = None,
                  heartbeat_timeout: float = 1800.0,
                  checkpoint_fn: Optional[Callable[[], None]] = None,
-                 on_wedge: Optional[Callable[[], None]] = None):
+                 on_wedge: Optional[Callable[[], None]] = None,
+                 hard_exit_on_wedge: bool = False,
+                 wedge_grace: float = 30.0):
         self.ds_config = ds_config
         self.world_size = world_size or int(os.environ.get("WORLD_SIZE", "1"))
         self.heartbeat_timeout = heartbeat_timeout
         self.checkpoint_fn = checkpoint_fn
-        # default wedge action: checkpoint then hard-exit for the scheduler
+        # default wedge action: checkpoint, then ESCALATE to the main thread
+        # (re-raise as TrainingWedgedError through run()) so the launcher's
+        # bounded-restart policy owns recovery; hard_exit_on_wedge restores the
+        # legacy abort (os._exit(WATCHDOG_EXIT_CODE)) for schedulers that only
+        # watch exit codes
         self._on_wedge = on_wedge or self._default_wedge_action
+        self.hard_exit_on_wedge = hard_exit_on_wedge
+        self.wedge_grace = wedge_grace
         self._last_beat = time.monotonic()
         self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self.wedged = False
         self.final_batch_size: Optional[int] = None
         self.valid_world_sizes: List[int] = []
         self.micro_batch: Optional[int] = None
@@ -74,12 +89,32 @@ class DSElasticAgent:
 
     def _default_wedge_action(self):
         logger.error(f"[elastic] no heartbeat for {self.heartbeat_timeout:.0f}s — "
-                     "checkpointing and exiting for scheduler restart")
+                     "checkpointing, then escalating to the main thread")
         if self.checkpoint_fn is not None:
             try:
                 self.checkpoint_fn()
             except Exception as e:  # the loop is wedged; save-or-die best effort
                 logger.error(f"[elastic] wedge checkpoint failed: {e}")
+        if self.hard_exit_on_wedge:
+            os._exit(WATCHDOG_EXIT_CODE)
+        # escalate: a process-directed SIGINT interrupts the main thread's
+        # EINTR-aware blocking calls (sleep, lock waits); run() converts the
+        # resulting KeyboardInterrupt to TrainingWedgedError so callers/
+        # launchers see a real, restartable failure instead of an abort
+        self.wedged = True
+        os.kill(os.getpid(), signal.SIGINT)
+        # a loop wedged inside a non-interruptible NATIVE call (a stuck XLA
+        # collective) never reaches the next bytecode boundary, so the
+        # KeyboardInterrupt cannot land — after the grace period fall back to
+        # the legacy hard abort so the scheduler still restarts us.
+        # run()'s finally sets _stop, which proves the main thread got free.
+        deadline = time.monotonic() + max(self.wedge_grace, 0.0)
+        while time.monotonic() < deadline:
+            if self._stop.wait(0.25):
+                return
+        logger.error(f"[elastic] main thread did not respond to the wedge "
+                     f"interrupt within {self.wedge_grace:.0f}s — hard exit "
+                     f"{WATCHDOG_EXIT_CODE} for scheduler restart")
         os._exit(WATCHDOG_EXIT_CODE)
 
     def _watch(self):
@@ -120,5 +155,16 @@ class DSElasticAgent:
         self.start()
         try:
             train_loop(self)
+        except KeyboardInterrupt:
+            if self.wedged:
+                # the watchdog interrupted a wedged loop after checkpointing:
+                # re-raise as a restartable failure (reference torchelastic
+                # restarts the worker group on failure; our launcher's
+                # --max_restarts policy does the same)
+                raise TrainingWedgedError(
+                    f"training loop wedged (no heartbeat for "
+                    f"{self.heartbeat_timeout:.0f}s); checkpoint attempted — "
+                    "restart from the latest committed tag") from None
+            raise
         finally:
             self.stop()

@@ -42,6 +42,11 @@ class DeepSpeedInferenceConfig(ConfigModel):
     replace_method: str = "auto"
     enable_cuda_graph: bool = False               # accepted; AOT decode is always compiled
     min_out_tokens: int = 1
+    # MoE decode implementation override applied to the resolved model config at
+    # engine construction ("pallas" | "xla"; None keeps the model's choice) —
+    # the supported way to select the impl, instead of mutating
+    # engine.model_config after the engine (and its compiled fns) exist
+    moe_decode_impl: Optional[str] = None
 
     # convenience aliases the reference accepts at top level
     mp_size: Optional[int] = None                 # deprecated alias of tensor_parallel.tp_size

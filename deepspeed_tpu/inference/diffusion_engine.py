@@ -22,8 +22,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.diffusion import (CLIPTextConfig, CLIPTextEncoder, UNet2DCondition,
                                 UNetConfig, VAEConfig, VAEDecoder)
-from ..parallel.mesh import AXIS_TENSOR, MeshSpec, set_global_mesh
-from ..utils.logging import log_dist
+from ..parallel.mesh import AXIS_TENSOR, MeshSpec, get_global_mesh, set_global_mesh
+from ..utils.logging import log_dist, logger
 
 # attention/ff projection names → Megatron column/row parallelism over the
 # tensor axis (the sharding the reference's containers apply to UNet/CLIP
@@ -86,7 +86,20 @@ class DiffusionInferenceEngine:
                        "vae": vae_params}
         self.mesh_spec = mesh_spec
         if mesh_spec is not None:
-            set_global_mesh(mesh_spec)
+            # the diffusion graph reads no global mesh — placement is explicit
+            # NamedShardings on the params — so only install the global mesh
+            # when the slot is free; NEVER clobber another engine's active mesh
+            # (a training engine constructed earlier in the process would have
+            # its sharding context silently swapped out from under it)
+            existing = get_global_mesh()
+            if existing is None:
+                set_global_mesh(mesh_spec)
+            elif existing is not mesh_spec:
+                logger.warning(
+                    "[diffusion] a different global mesh is already installed; "
+                    "leaving it in place — this engine's shardings are "
+                    "self-contained (explicit NamedShardings), but mixed-mesh "
+                    "processes should scope engines to separate processes")
             self.params = shard_diffusion_params(self.params, mesh_spec)
         self.alphas_cumprod = ddim_schedule(num_train_timesteps)
         self.num_train_timesteps = num_train_timesteps

@@ -58,10 +58,21 @@ class InferenceEngine:
         # must not leak into this engine's init/forward traces
         set_global_mesh(self.mesh_spec)
 
+        # validate the impl override BEFORE any model resolution/tracing so a
+        # bad value ('triton', 'XLA') fails fast at construction
+        if self._config.moe_decode_impl is not None and \
+                self._config.moe_decode_impl not in \
+                CausalLMConfig.VALID_MOE_DECODE_IMPLS:
+            raise ValueError(
+                f"moe_decode_impl={self._config.moe_decode_impl!r} is not "
+                f"one of {CausalLMConfig.VALID_MOE_DECODE_IMPLS}")
         self.model_config, self.params = self._resolve_model(model, params, seed)
         self.dtype = self._config.jax_dtype()
         # serve dtype wins over the model's training dtype (reference _convert_to_dtype:462)
         self.model_config.dtype = self.dtype
+        if self._config.moe_decode_impl is not None:
+            # applied before the module exists so every compiled fn sees it
+            self.model_config.moe_decode_impl = self._config.moe_decode_impl
         self.module = CausalLM(self.model_config)
 
         self._shard_params()

@@ -35,6 +35,13 @@ def parse_args(args=None):
                         help="interpret the script as a python module (python -m)")
     parser.add_argument("--no_python", action="store_true",
                         help="exec the script directly, not via the python interpreter")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="restart the whole worker group up to N times after a "
+                             "rank failure (training scripts resume from the latest "
+                             "committed checkpoint tag)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="base seconds between restarts (exponential: "
+                             "base * 2**attempt)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -50,11 +57,8 @@ def build_cmd(args) -> List[str]:
     return cmd + list(args.training_script_args)
 
 
-def main(args=None):
-    args = parse_args(args)
-    world_size = args.num_nodes * args.nproc_per_node
-    cmd = build_cmd(args)
-
+def _spawn_group(args, world_size: int, cmd: List[str],
+                 attempt: int) -> List[subprocess.Popen]:
     processes: List[subprocess.Popen] = []
     for local_rank in range(args.nproc_per_node):
         env = os.environ.copy()
@@ -66,23 +70,19 @@ def main(args=None):
             args.node_rank * args.nproc_per_node + local_rank)
         env["LOCAL_RANK"] = str(local_rank)
         env["NODE_RANK"] = str(args.node_rank)
+        env["DS_TPU_RESTART_ATTEMPT"] = str(attempt)
         logger.info(f"[launch] node {args.node_rank} local {local_rank} -> "
-                    f"rank {env['RANK']}/{world_size}: {' '.join(cmd)}")
+                    f"rank {env['RANK']}/{world_size}"
+                    f"{f' (restart {attempt})' if attempt else ''}: "
+                    f"{' '.join(cmd)}")
         processes.append(subprocess.Popen(cmd, env=env))
+    return processes
 
-    def forward_signal(signum, frame):
-        for p in processes:
-            if p.poll() is None:
-                try:
-                    p.send_signal(signum)
-                except OSError:
-                    pass
 
-    signal.signal(signal.SIGINT, forward_signal)
-    signal.signal(signal.SIGTERM, forward_signal)
-
-    # reference launch.py poll loop: first non-zero exit kills the rest, escalating
-    # terminate -> kill so a worker stuck in a collective (SIGTERM pending) can't hang us
+def _wait_group(processes: List[subprocess.Popen]) -> int:
+    """Reference launch.py poll loop: first non-zero exit kills the rest,
+    escalating terminate -> kill so a worker stuck in a collective (SIGTERM
+    pending) can't hang us. Returns the first failing exit code (0 = clean)."""
     exit_code = 0
     kill_deadline = None
     alive = list(processes)
@@ -110,6 +110,62 @@ def main(args=None):
                         q.terminate()
                     except OSError:
                         pass
+    return exit_code
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_size = args.num_nodes * args.nproc_per_node
+    cmd = build_cmd(args)
+
+    processes: List[subprocess.Popen] = []
+    signaled = {"got": None}
+
+    def forward_signal(signum, frame):
+        signaled["got"] = signum      # operator/scheduler stop: no restart
+        for p in processes:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signum)
+                except OSError:
+                    pass
+
+    signal.signal(signal.SIGINT, forward_signal)
+    signal.signal(signal.SIGTERM, forward_signal)
+
+    # bounded rank-failure restarts (reference torchelastic max_restarts): a
+    # crash/wedge respawns the WHOLE group after exponential backoff; training
+    # scripts resume from the latest committed checkpoint tag. Single-node
+    # scope: multi-node jobs restart through the scheduler (the whole-slice
+    # replacement discipline, see elastic_agent.py docstring).
+    max_restarts = max(0, args.max_restarts)
+    if max_restarts and args.num_nodes > 1:
+        logger.warning("[launch] --max_restarts on a multi-node job restarts "
+                       "only this node's workers; the coordinator contract "
+                       "requires ALL nodes to restart — prefer scheduler-level "
+                       "restarts for multi-node")
+    exit_code = 0
+    for attempt in range(max_restarts + 1):
+        processes[:] = _spawn_group(args, world_size, cmd, attempt)
+        exit_code = _wait_group(processes)
+        if exit_code == 0:
+            break
+        if signaled["got"] is not None:
+            logger.info(f"[launch] stopped by signal {signaled['got']}; "
+                        "not restarting")
+            break
+        if attempt < max_restarts:
+            delay = args.restart_backoff * (2 ** attempt)
+            logger.error(f"[launch] worker group failed (exit {exit_code}); "
+                         f"restart {attempt + 1}/{max_restarts} in {delay:.1f}s")
+            time.sleep(delay)
+            # a stop signal delivered DURING the backoff sleep must also
+            # suppress the respawn (PEP 475 resumes the sleep after the
+            # handler runs, so the loop-top check alone would miss it)
+            if signaled["got"] is not None:
+                logger.info(f"[launch] stopped by signal {signaled['got']} "
+                            "during backoff; not restarting")
+                break
     sys.exit(exit_code)
 
 

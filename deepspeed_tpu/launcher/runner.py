@@ -56,6 +56,11 @@ def parse_args(args=None):
     parser.add_argument("--no_python", action="store_true")
     parser.add_argument("--force_multi", action="store_true",
                         help="treat as multi-node even when resources look local")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="per-node bounded restarts after a rank failure "
+                             "(see launch.py; resume from the latest committed tag)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="base seconds for the exponential restart backoff")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -190,6 +195,9 @@ def run_local(args, nproc: int) -> int:
         cmd.append("--module")
     if args.no_python:
         cmd.append("--no_python")
+    if args.max_restarts:
+        cmd += [f"--max_restarts={args.max_restarts}",
+                f"--restart_backoff={args.restart_backoff}"]
     cmd += [args.user_script] + list(args.user_args)
     try:
         launch.main(cmd)
@@ -224,6 +232,9 @@ def run_ssh(args, resources: "OrderedDict[str, int]") -> int:
             remote.append("--module")
         if args.no_python:
             remote.append("--no_python")
+        if args.max_restarts:
+            remote += [f"--max_restarts={args.max_restarts}",
+                       f"--restart_backoff={args.restart_backoff}"]
         # quote: the remote shell re-tokenizes the joined string
         remote += [shlex.quote(args.user_script)]
         remote += [shlex.quote(a) for a in args.user_args]

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.attention.decode import decode_attention, decode_attention_xla
 from ..ops.transformer.attention import xla_attention
 from .base import Model
+from ..utils.jax_compat import shard_map
 
 
 @dataclasses.dataclass
@@ -66,6 +67,16 @@ class CausalLMConfig:
     # "pallas" = gather-fused kernel (weights stream HBM→MXU once);
     # "xla" = w[idx] gather + einsum (lets XLA pin small expert stacks in VMEM)
     moe_decode_impl: str = "pallas"
+
+    VALID_MOE_DECODE_IMPLS = ("pallas", "xla")
+
+    def __post_init__(self):
+        # case-sensitive on purpose: 'XLA'/'Pallas'/'triton' must not silently
+        # select the pallas path through a failed == "xla" comparison
+        if self.moe_decode_impl not in self.VALID_MOE_DECODE_IMPLS:
+            raise ValueError(
+                f"moe_decode_impl={self.moe_decode_impl!r} is not one of "
+                f"{self.VALID_MOE_DECODE_IMPLS}")
 
     def is_moe_layer(self, i: int) -> bool:
         return self.num_experts > 0 and (i + 1) % self.moe_layer_interval == 0
@@ -296,6 +307,12 @@ class CausalLMLayer(nn.Module):
             xk = x.astype(cdtype)
             if k > 1:
                 xk = jnp.repeat(xk, k, axis=0)                            # (b*k, d)
+            # dispatch-time re-validation: configs mutated after construction
+            # (engine plumbing) must not silently fall through to pallas
+            if cfg.moe_decode_impl not in CausalLMConfig.VALID_MOE_DECODE_IMPLS:
+                raise ValueError(
+                    f"moe_decode_impl={cfg.moe_decode_impl!r} is not one of "
+                    f"{CausalLMConfig.VALID_MOE_DECODE_IMPLS}")
             ffn = (moe_decode_ffn_xla if cfg.moe_decode_impl == "xla"
                    else moe_decode_ffn)
             y = ffn(xk, idx.reshape(-1),
@@ -455,13 +472,13 @@ def _sharded_decode(q, k_cache, v_cache, lens, alibi=None):
             cspec = P(batch_axes or None, tpax, None, None)
             lspec = P(batch_axes or None)
             if alibi is None:
-                mapped = jax.shard_map(
+                mapped = shard_map(
                     lambda q_l, k_l, v_l, l_l: decode_attention(q_l, k_l, v_l, l_l),
                     mesh=mesh.mesh, axis_names=manual,
                     in_specs=(qspec, cspec, cspec, lspec), out_specs=qspec,
                     check_vma=False)
                 return mapped(q, k_cache, v_cache, lens)
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 decode_attention_xla_alibi, mesh=mesh.mesh, axis_names=manual,
                 in_specs=(qspec, cspec, cspec, lspec, P(tpax)), out_specs=qspec,
                 check_vma=False)

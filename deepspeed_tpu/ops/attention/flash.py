@@ -34,6 +34,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
+from ...utils.jax_compat import shard_map
+
+# jax >= 0.5 renames TPUCompilerParams -> CompilerParams; support both so the
+# kernels load on either side of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
 
 NEG_INF = -1e30
 
@@ -188,7 +194,7 @@ def _flash_fwd(q3, k3, v3, slopes3, scale, causal, block_q, block_k, t_valid):
             pltpu.VMEM((1, 8, bq), jnp.float32),      # l
             pltpu.VMEM((1, bq, d), jnp.float32),      # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -335,7 +341,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, slopes3, scale, causal, block_q, block_
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((1, bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*dq_args)
@@ -369,7 +375,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, slopes3, scale, causal, block_q, block_
         ],
         scratch_shapes=[pltpu.VMEM((1, bk, d), jnp.float32),
                         pltpu.VMEM((1, bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*dkv_args)
@@ -486,13 +492,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             if use_alibi:
                 # slopes shard over the head (TP) axis: each shard sees its heads'
                 sspec = P(AXIS_TENSOR if use_tp else None)
-                mapped = jax.shard_map(
+                mapped = shard_map(
                     lambda q4, k4, v4, s: local(q4, k4, v4, s),
                     mesh=mesh.mesh, axis_names=manual,
                     in_specs=(spec,) * 3 + (sspec,), out_specs=spec,
                     check_vma=False)
                 return mapped(q, k, v, jnp.asarray(alibi_slopes, jnp.float32))
-            mapped = jax.shard_map(local, mesh=mesh.mesh, axis_names=manual,
+            mapped = shard_map(local, mesh=mesh.mesh, axis_names=manual,
                                    in_specs=(spec,) * 3, out_specs=spec,
                                    check_vma=False)
             return mapped(q, k, v)

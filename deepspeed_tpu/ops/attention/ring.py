@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import AXIS_SEQ, get_global_mesh
+from ...utils.jax_compat import shard_map
 
 NEG_BIG = -1e30
 
@@ -135,7 +136,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     assert t % S == 0, f"seq len {t} must divide the seq axis {S}"
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         lambda q_l, k_l, v_l: ring_attention_local(
             q_l, k_l, v_l, causal=causal, softmax_scale=scale,
             axis_name=axis_name, seq_size=S),

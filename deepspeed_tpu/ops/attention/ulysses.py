@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import AXIS_SEQ, get_global_mesh
+from ...utils.jax_compat import shard_map
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -70,7 +71,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         o = jnp.einsum("bhts,bshd->bthd", p, vh)
         return heads_to_seq(o).astype(q_l.dtype)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         ulysses_fn,
         mesh=mesh.mesh,
         axis_names={axis_name},

@@ -19,7 +19,9 @@ with reference training loops; ``train_batch()`` is the fused fast path.
 """
 
 import os
-from typing import Any, Dict, NamedTuple, Optional
+import signal
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,10 +36,14 @@ from ..ops.adam.fused_adam import fused_adam
 from ..ops.lamb.fused_lamb import fused_lamb
 from ..ops.optimizer import Optimizer, from_optax
 from ..parallel.mesh import MeshSpec, set_global_mesh
+from ..utils.fault_injection import fault_point
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
                            SynchronizedWallClockTimer, ThroughputTimer, TRAIN_BATCH_TIMER)
-from .checkpoint_engine.checkpoint_engine import make_checkpoint_engine
+from .checkpoint_engine.checkpoint_engine import (
+    CheckpointCorruptionError, LATEST_FILE, find_latest_committed_tag,
+    is_committed_tag, make_checkpoint_engine, validate_manifest,
+    write_latest_pointer)
 from .dataloader import DeepSpeedDataLoader, RepeatingLoader
 from .fp16.loss_scaler import DynamicLossScaler, LossScaleState, create_loss_scaler
 from .lr_schedules import get_lr_scheduler
@@ -45,8 +51,6 @@ from .utils import (clip_by_global_norm, count_parameters, global_norm, tree_cas
                     tree_zeros_like)
 from .zero.partition import (grad_accum_specs, optimizer_state_specs, param_specs,
                              to_shardings)
-
-LATEST_FILE = "latest"
 
 
 class TrainState(NamedTuple):
@@ -1032,11 +1036,25 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
         """Reference ``engine.py:3085``. Orbax writes sharded arrays once across hosts; the
-        result is re-shardable to any topology (universal checkpoint by construction)."""
+        result is re-shardable to any topology (universal checkpoint by construction).
+
+        Crash-consistent: all data is staged into ``<save_dir>/<tag>.tmp`` and
+        published by ``commit_tag`` (manifest + fsync + one atomic rename); the
+        ``latest`` pointer advances only after the rename lands, so a kill at any
+        point leaves the previous committed tag loadable (see
+        ``docs/FAULT_TOLERANCE.md``)."""
         tag = tag or f"global_step{self.global_steps}"
-        path = os.path.join(save_dir, str(tag))
-        self.checkpoint_engine.makedirs(path)
-        self.checkpoint_engine.create(tag)
+        # rank 0 alone reclaims stale staging (a racing reclaim would rmtree
+        # peers' in-flight writes on a shared filesystem); peers join the
+        # staging dir only after the barrier
+        if dist.get_rank() == 0:
+            path = self.checkpoint_engine.begin_tag(save_dir, tag)
+        else:
+            path = self.checkpoint_engine.staging_path(save_dir, tag)
+        dist.barrier("ckpt_begin")
+        if dist.get_rank() != 0:
+            os.makedirs(path, exist_ok=True)
+        fault_point("ckpt.save.begin")
         if self.param_offload_enabled:
             # the full model exists only as host fp32 masters — serialize those (plus
             # moments/scaler) as the checkpoint; there is no device state to save
@@ -1062,28 +1080,74 @@ class DeepSpeedEngine:
         }
         self.checkpoint_engine.save(side, os.path.join(path, "client_state.pkl"))
         dist.barrier("ckpt_save")
-        # commit (the async-save drain barrier) BEFORE advancing 'latest': a crash
-        # mid-drain must leave 'latest' pointing at the previous durable checkpoint
-        self.checkpoint_engine.commit(tag)
+        # non-zero ranks drain their async writes, then a barrier proves every
+        # peer's shards are durable BEFORE rank 0 hashes the manifest and
+        # renames (commit_tag drains rank 0's own writer internally) — a crash
+        # anywhere before the rename leaves 'latest' at the previous durable tag
+        if dist.get_rank() != 0:
+            self.checkpoint_engine.commit(tag)
+        dist.barrier("ckpt_drain")
+        if dist.get_rank() == 0:
+            final = self.checkpoint_engine.commit_tag(save_dir, tag)
+        else:
+            final = os.path.join(save_dir, str(tag))
+        dist.barrier("ckpt_commit")
         if save_latest and dist.get_rank() == 0:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
-        return path
+            write_latest_pointer(save_dir, tag)
+        return final
+
+    def _resolve_load_tag(self, load_dir: str, tag: Optional[str]):
+        """Tag resolution with torn-checkpoint fallback: an explicit ``tag`` is
+        trusted (validation still runs at load); otherwise follow ``latest``,
+        and when it names a missing/uncommitted tag, fall back to the newest
+        committed tag on disk."""
+        if tag is not None:
+            return str(tag)
+        latest_path = os.path.join(load_dir, LATEST_FILE)
+        pointed = None
+        if os.path.isfile(latest_path):
+            with open(latest_path) as f:
+                pointed = f.read().strip()
+        if pointed and is_committed_tag(load_dir, pointed):
+            return pointed
+        fallback = find_latest_committed_tag(load_dir, exclude=pointed)
+        if fallback is not None:
+            if pointed:
+                logger.error(
+                    f"[ckpt] '{LATEST_FILE}' points at {pointed!r} which is "
+                    f"missing or uncommitted — falling back to newest committed "
+                    f"tag {fallback!r}")
+            return fallback
+        if pointed:
+            # nothing committed to fall back to: surface the torn tag loudly
+            return pointed
+        return None
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True,
-                        load_module_only: bool = False):
+                        load_module_only: bool = False,
+                        validate: bool = True):
         """Reference ``engine.py:2725``. Restores into the CURRENT mesh/sharding regardless of
-        the topology that wrote the checkpoint (universal-checkpoint semantics)."""
-        if tag is None:
-            latest_path = os.path.join(load_dir, LATEST_FILE)
-            if not os.path.isfile(latest_path):
-                logger.warning(f"No '{LATEST_FILE}' file at {load_dir}; nothing loaded")
-                return None, {}
-            with open(latest_path) as f:
-                tag = f.read().strip()
+        the topology that wrote the checkpoint (universal-checkpoint semantics).
+
+        Integrity: the tag's SHA-256 manifest is validated before anything is
+        restored (``CheckpointCorruptionError`` names the offending shard);
+        ``tag=None`` resolves via ``latest`` with automatic fallback to the
+        newest *committed* tag when the pointer is torn."""
+        resolved = self._resolve_load_tag(load_dir, tag)
+        if resolved is None:
+            logger.warning(f"No '{LATEST_FILE}' file at {load_dir} and no "
+                           "committed tags found; nothing loaded")
+            return None, {}
+        tag = resolved
         path = os.path.join(load_dir, str(tag))
+        if not os.path.isdir(path):
+            raise CheckpointCorruptionError(
+                f"checkpoint tag {tag!r} does not exist under {load_dir}")
+        if validate:
+            validate_manifest(path)
+        fault_point("ckpt.load.begin")
         if self.param_offload_enabled:
             self._param_offload.load_from(
                 self.checkpoint_engine, os.path.join(path, "offload_state"),
@@ -1144,4 +1208,130 @@ class DeepSpeedEngine:
             self.lr_scheduler.load_state_dict(side["lr_scheduler"])
         client_state = side.get("client_state", {})
         log_dist(f"loaded checkpoint {path} at global_step={self.global_steps}", ranks=[0])
+        return path, client_state
+
+
+class CheckpointAutoSaver:
+    """Preemption-aware automatic checkpointing around a :class:`DeepSpeedEngine`.
+
+    Two triggers (reference: megatron-style ``--save-interval`` + the launcher's
+    SIGTERM propagation discipline):
+
+    - every ``interval_steps`` optimizer steps, ``after_step()`` saves a tag;
+    - on SIGTERM (scheduler preemption) the handler only sets a flag — the save
+      happens at the next ``after_step()`` call, i.e. at a step boundary where
+      the engine state is consistent — then a ``preempted`` marker naming the
+      tag is written and ``SystemExit(128+SIGTERM)`` is raised so the launcher /
+      scheduler restarts the job, which resumes via ``resume()``.
+
+    Usage::
+
+        saver = CheckpointAutoSaver(engine, save_dir, interval_steps=100)
+        saver.resume()                     # load latest committed tag, if any
+        with saver:                        # installs the SIGTERM handler
+            for batch in data:
+                engine.train_batch(batch)
+                saver.after_step()
+    """
+
+    PREEMPT_MARKER = "preempted"
+
+    def __init__(self, engine, save_dir: str, interval_steps: int = 0,
+                 tag_prefix: str = "global_step", exit_on_preempt: bool = True,
+                 client_state_fn: Optional[Callable[[], dict]] = None):
+        self.engine = engine
+        self.save_dir = save_dir
+        self.interval_steps = int(interval_steps)
+        self.tag_prefix = tag_prefix
+        self.exit_on_preempt = exit_on_preempt
+        self.client_state_fn = client_state_fn
+        self._preempt = threading.Event()
+        self._prev_handler = None
+        self._installed = False
+        self.last_saved_tag: Optional[str] = None
+
+    # ------------------------------------------------------------- signal wiring
+    def install(self) -> "CheckpointAutoSaver":
+        """Install the SIGTERM handler (main thread only — a no-op flag set, so
+        it is safe inside any training loop)."""
+        self._prev_handler = signal.signal(signal.SIGTERM, self._on_sigterm)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev_handler or signal.SIG_DFL)
+            self._installed = False
+
+    def __enter__(self) -> "CheckpointAutoSaver":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    def _on_sigterm(self, signum, frame):
+        logger.warning("[autosave] SIGTERM received — checkpoint at next step "
+                       "boundary, then exit for scheduler restart")
+        self._preempt.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempt.is_set()
+
+    # ------------------------------------------------------------------- saving
+    def save(self, mark_preempted: bool = False) -> str:
+        tag = f"{self.tag_prefix}{self.engine.global_steps}"
+        client_state = self.client_state_fn() if self.client_state_fn else None
+        path = self.engine.save_checkpoint(self.save_dir, tag=tag,
+                                           client_state=client_state)
+        self.last_saved_tag = tag
+        if mark_preempted and dist.get_rank() == 0:
+            marker = os.path.join(self.save_dir, self.PREEMPT_MARKER)
+            with open(marker + ".tmp", "w") as f:
+                f.write(tag)
+            os.rename(marker + ".tmp", marker)
+        return path
+
+    def after_step(self) -> Optional[str]:
+        """Call once per optimizer step. Saves when the interval elapses or a
+        preemption is pending; on preemption also exits (``exit_on_preempt``).
+        Returns the saved path, or None when no save was due.
+
+        Multi-host: ranks can observe SIGTERM on different step boundaries, so
+        the flag is agreed via a max-allreduce each step — every rank then
+        enters the collective save at the SAME step (mismatched steps would
+        deadlock the save barriers)."""
+        preempted = self._preempt.is_set()
+        if dist.get_world_size() > 1:
+            agreed = dist.all_reduce(np.asarray(int(preempted), np.int32),
+                                     op="max")
+            if bool(agreed) and not preempted:
+                self._preempt.set()
+            preempted = bool(agreed)
+        if preempted:
+            path = self.save(mark_preempted=True)
+            if self.exit_on_preempt:
+                raise SystemExit(128 + signal.SIGTERM)
+            self._preempt.clear()
+            return path
+        steps = self.engine.global_steps
+        if self.interval_steps > 0 and steps > 0 \
+                and steps % self.interval_steps == 0 \
+                and self.last_saved_tag != f"{self.tag_prefix}{steps}":
+            return self.save()
+        return None
+
+    # ----------------------------------------------------------------- resuming
+    def resume(self):
+        """Load the newest committed checkpoint (via ``latest`` with torn-tag
+        fallback) and clear any preemption marker. Returns
+        ``(path, client_state)`` or ``(None, {})`` when nothing is saved yet."""
+        path, client_state = self.engine.load_checkpoint(self.save_dir)
+        marker = os.path.join(self.save_dir, self.PREEMPT_MARKER)
+        if os.path.isfile(marker):
+            if dist.get_rank() == 0:
+                logger.info(f"[autosave] resuming after preemption "
+                            f"(marker tag {open(marker).read().strip()!r})")
+                os.unlink(marker)
         return path, client_state

@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import AXIS_EXPERT, AXIS_PIPE, MeshSpec
 from ...utils.logging import logger
+from ...utils.jax_compat import shard_map
 
 
 # --------------------------------------------------------------------------- layer contract
@@ -532,13 +533,17 @@ class PipelineModule:
             return jax.vmap(lambda x, r: stage_fn(params["body"], x, r))(
                 xs, jax.random.split(rng, M))
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             run,
             mesh=mesh_spec.mesh,
             axis_names={AXIS_PIPE},
             in_specs=(P(AXIS_PIPE), P(), P()),
             out_specs=P(AXIS_PIPE),
             check_vma=False,
+            # NOTE on old jax (no jax.shard_map): the shim runs fully manual —
+            # data/expert stay replicated through the region (values identical,
+            # redundant compute); expert-sharded MoE pipe bodies need true
+            # partial-auto and are unsupported there (fail loudly at trace)
         )
         stacked = mapped(params["body"], xs, rng)  # (S, M, mb, ...)
         return stacked[S - 1]
@@ -899,7 +904,7 @@ class PipelineModule:
                 manual_axes = {AXIS_PIPE}
             if sp > 1:
                 manual_axes = manual_axes | {sp_axis}
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 run,
                 mesh=mesh.mesh,
                 axis_names=manual_axes,

@@ -470,15 +470,15 @@ class OffloadOptimizerTier:
             fn = path + f"_part{jax.process_index()}.npz"
             data = {f"master_{i}": m for i, m in enumerate(self.masters)}
             if self.nvme is not None:
-                data["step"] = np.int64(self.step_count)
+                data["step"] = np.asarray(self.step_count, dtype=np.int64)
                 self.nvme.copy_files_to(path + f"_moments_p{jax.process_index()}")
             elif self.kind == "adam":
                 sd = self.opt.state_dict()
-                data["step"] = np.int64(sd["step"])
+                data["step"] = np.asarray(sd["step"], dtype=np.int64)
                 for i, (m, v) in enumerate(zip(sd["m"], sd["v"])):
                     data[f"m_{i}"], data[f"v_{i}"] = m, v
             else:
-                data["step"] = np.int64(self.step_count)
+                data["step"] = np.asarray(self.step_count, dtype=np.int64)
                 for i, s in enumerate(self.sq_sum):
                     data[f"sq_{i}"] = s
             np.savez(fn, **data)
@@ -487,7 +487,7 @@ class OffloadOptimizerTier:
             import os
             light = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])
                                  for i, m in enumerate(self.masters)},
-                     "step": np.int64(self.step_count)}
+                     "step": np.asarray(self.step_count, dtype=np.int64)}
             checkpoint_engine.save(light, path)
             self.nvme.copy_files_to(path + "_moments")
             return
@@ -518,7 +518,7 @@ class OffloadOptimizerTier:
         if self.nvme is not None:
             light = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])
                                  for i, m in enumerate(self.masters)},
-                     "step": np.int64(0)}
+                     "step": np.asarray(0, dtype=np.int64)}
             restored = checkpoint_engine.load(path, template=light)
             for i, m in enumerate(self.masters):
                 np.copyto(m, np.asarray(restored["masters"][f"leaf{i}"],
@@ -543,18 +543,18 @@ class OffloadOptimizerTier:
                        for i, m in enumerate(ms)}
             sd["v"] = {f"leaf{i}": v.reshape(self._shapes[i])
                        for i, v in enumerate(vs)}
-            sd["step"] = np.int64(self.step_count)
+            sd["step"] = np.asarray(self.step_count, dtype=np.int64)
         elif self.kind == "adam":
             opt_sd = self.opt.state_dict()
             sd["m"] = {f"leaf{i}": m.reshape(self._shapes[i])
                        for i, m in enumerate(opt_sd["m"])}
             sd["v"] = {f"leaf{i}": v.reshape(self._shapes[i])
                        for i, v in enumerate(opt_sd["v"])}
-            sd["step"] = np.int64(opt_sd["step"])
+            sd["step"] = np.asarray(opt_sd["step"], dtype=np.int64)
         else:
             sd["sq_sum"] = {f"leaf{i}": s.reshape(self._shapes[i])
                             for i, s in enumerate(self.sq_sum)}
-            sd["step"] = np.int64(self.step_count)
+            sd["step"] = np.asarray(self.step_count, dtype=np.int64)
         return sd
 
     def load_state_dict(self, sd: dict):

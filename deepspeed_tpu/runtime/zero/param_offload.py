@@ -957,7 +957,7 @@ class ParamOffloadCoordinator:
         checkpoint path uses this so the on-disk moment store is never materialised in
         host RAM (the tier exists because 2× fp32 moments don't fit there). With
         masters themselves on NVMe they are excluded too (streamed by file copy)."""
-        sd: Dict[str, Any] = {"step": np.int64(getattr(self, "step_count", 0))}
+        sd: Dict[str, Any] = {"step": np.asarray(getattr(self, "step_count", 0), dtype=np.int64)}
         if not self.nvme_params and not self._partitioned:
             for k in self._key_order:
                 for li, (m, s) in enumerate(zip(self.masters[k],
@@ -993,7 +993,7 @@ class ParamOffloadCoordinator:
                 sd[f"m/{i}"], sd[f"v/{i}"] = m, v
         elif self.kind in ("adam", "adamw"):
             opt_sd = self.opt.state_dict()
-            sd["step"] = np.int64(opt_sd["step"])
+            sd["step"] = np.asarray(opt_sd["step"], dtype=np.int64)
             for i, (m, v) in enumerate(zip(opt_sd["m"], opt_sd["v"])):
                 sd[f"m/{i}"], sd[f"v/{i}"] = m, v
         else:
@@ -1071,7 +1071,7 @@ class ParamOffloadCoordinator:
                     enumerate(self._masters_p or [])}
             data["meta_json"] = np.frombuffer(
                 json.dumps(self._partition_meta()).encode(), np.uint8)
-            data["step"] = np.int64(getattr(self, "step_count", 0))
+            data["step"] = np.asarray(getattr(self, "step_count", 0), dtype=np.int64)
             if self.scaler_state is not None:
                 data["scaler"] = self._light_state_dict()["scaler"]
             if self.nvme_params:
@@ -1080,7 +1080,7 @@ class ParamOffloadCoordinator:
                 self.nvme.copy_files_to(path + f"_moments_p{rank}")
             elif self.kind in ("adam", "adamw"):
                 sd = self.opt.state_dict()
-                data["step"] = np.int64(sd["step"])
+                data["step"] = np.asarray(sd["step"], dtype=np.int64)
                 for i, (m, v) in enumerate(zip(sd["m"], sd["v"])):
                     data[f"m_{i}"], data[f"v_{i}"] = m, v
             else:

@@ -1,3 +1,5 @@
 from .logging import logger, log_dist, print_rank_0
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from .comms_logging import CommsLogger, calc_bw_log
+from .fault_injection import (FaultSpec, fault_point, faults_fired, inject,
+                              reset_faults, retry_with_backoff)

@@ -1,0 +1,222 @@
+"""Deterministic fault injection + retry — the substrate of the fault-tolerance ring.
+
+The reference framework is tested against rank death, preemption, and flaky storage
+by running real clusters; this repo's CPU-lane equivalent is a *seedable, in-process
+fault registry*: production I/O paths declare named fault points
+(``fault_point("ckpt.save")``) that are free when no fault is registered, and tests
+arm them with :class:`FaultSpec` to prove recovery behaviour deterministically:
+
+    with inject("ckpt.save", FaultSpec(kind="io_error", max_faults=2)):
+        engine.save_checkpoint(...)     # first two shard writes raise OSError
+
+Fault kinds:
+
+- ``io_error`` — raise ``spec.exc_type(spec.message)`` (default OSError): a flaky
+  filesystem / object store;
+- ``kill`` — ``os.kill(os.getpid(), SIGKILL)``: a preemption landing mid-operation
+  (only meaningful in subprocess-driven tests — the process dies for real);
+- ``delay`` — sleep ``spec.delay_s``: a slow device, for timeout-path testing.
+
+Probabilistic faults (``prob < 1``) draw from a dedicated seeded RNG so a failing
+test replays exactly. All registry state is process-local and reset by
+:func:`reset_faults` (tests) — production code never registers faults, so the
+hot-path cost is one dict lookup against an empty dict.
+
+:func:`retry_with_backoff` is the shared retry policy for every I/O path that can
+see transient errors (checkpoint shard writes, manifest reads, NVMe copies):
+bounded attempts, exponential backoff, retry only on ``retryable`` exception types.
+"""
+
+import os
+import random
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+from .logging import logger
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault at a named site.
+
+    ``after_n`` passes through the first N hits unharmed; ``max_faults`` bounds how
+    many times the fault fires (None = every eligible hit); ``prob`` gates each
+    eligible hit through the registry's seeded RNG.
+    """
+    kind: str = "io_error"              # io_error | kill | delay
+    prob: float = 1.0
+    after_n: int = 0
+    max_faults: Optional[int] = None
+    exc_type: Type[BaseException] = OSError
+    message: str = "injected fault"
+    delay_s: float = 0.05
+
+    def __post_init__(self):
+        if self.kind not in ("io_error", "kill", "delay"):
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             "(expected io_error | kill | delay)")
+
+
+@dataclass
+class _ArmedFault:
+    spec: FaultSpec
+    hits: int = 0
+    fired: int = 0
+
+
+class FaultRegistry:
+    """Process-local registry of armed faults, keyed by site name."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._faults: Dict[str, List[_ArmedFault]] = {}
+        self._rng = random.Random(seed)
+        self._fired: Dict[str, int] = {}
+
+    def reseed(self, seed: int):
+        with self._lock:
+            self._rng = random.Random(seed)
+
+    def arm(self, site: str, spec: FaultSpec) -> _ArmedFault:
+        armed = _ArmedFault(spec)
+        with self._lock:
+            self._faults.setdefault(site, []).append(armed)
+        return armed
+
+    def disarm(self, site: str, armed: _ArmedFault):
+        with self._lock:
+            lst = self._faults.get(site, [])
+            if armed in lst:
+                lst.remove(armed)
+            if not lst:
+                self._faults.pop(site, None)
+
+    def reset(self):
+        with self._lock:
+            self._faults.clear()
+            self._fired.clear()
+            self._rng = random.Random(0)
+
+    def fired(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            if site is not None:
+                return self._fired.get(site, 0)
+            return sum(self._fired.values())
+
+    def check(self, site: str):
+        """The fault point: decide (under the lock) whether an armed fault fires,
+        then act outside the lock. No-op when nothing is armed at ``site``."""
+        if not self._faults:        # fast path: injection entirely disabled
+            return
+        to_fire: Optional[FaultSpec] = None
+        with self._lock:
+            for armed in self._faults.get(site, ()):
+                spec = armed.spec
+                armed.hits += 1
+                if armed.hits <= spec.after_n:
+                    continue
+                if spec.max_faults is not None and armed.fired >= spec.max_faults:
+                    continue
+                if spec.prob < 1.0 and self._rng.random() >= spec.prob:
+                    continue
+                armed.fired += 1
+                self._fired[site] = self._fired.get(site, 0) + 1
+                to_fire = spec
+                break
+        if to_fire is None:
+            return
+        if to_fire.kind == "delay":
+            time.sleep(to_fire.delay_s)
+            return
+        if to_fire.kind == "kill":
+            logger.error(f"[fault] kill injected at {site!r}")
+            os.kill(os.getpid(), signal.SIGKILL)
+        logger.warning(f"[fault] {to_fire.kind} injected at {site!r}: "
+                       f"{to_fire.message}")
+        raise to_fire.exc_type(f"{to_fire.message} [site={site}]")
+
+
+_REGISTRY = FaultRegistry()
+
+
+def get_registry() -> FaultRegistry:
+    return _REGISTRY
+
+
+def fault_point(site: str):
+    """Named fault point — call from production I/O paths. Free when no fault is
+    armed (one falsy-dict check)."""
+    _REGISTRY.check(site)
+
+
+class inject:
+    """Arm ``spec`` at ``site`` for the scope of the context manager (re-entrant
+    and usable as a plain object with ``.arm()/.disarm()`` for subprocess drivers
+    that never exit the scope)."""
+
+    def __init__(self, site: str, spec: FaultSpec):
+        self.site = site
+        self.spec = spec
+        self._armed: Optional[_ArmedFault] = None
+
+    def arm(self) -> "inject":
+        self._armed = _REGISTRY.arm(self.site, self.spec)
+        return self
+
+    def disarm(self):
+        if self._armed is not None:
+            _REGISTRY.disarm(self.site, self._armed)
+            self._armed = None
+
+    @property
+    def fired(self) -> int:
+        return self._armed.fired if self._armed is not None else 0
+
+    def __enter__(self) -> "inject":
+        return self.arm()
+
+    def __exit__(self, *exc):
+        self.disarm()
+        return False
+
+
+def faults_fired(site: Optional[str] = None) -> int:
+    """How many faults have fired (at ``site``, or in total)."""
+    return _REGISTRY.fired(site)
+
+
+def reset_faults():
+    _REGISTRY.reset()
+
+
+def retry_with_backoff(fn: Callable, retries: int = 3, base_delay: float = 0.05,
+                       max_delay: float = 2.0,
+                       retryable: Tuple[Type[BaseException], ...] = (OSError,),
+                       on_retry: Optional[Callable[[int, BaseException], None]]
+                       = None,
+                       sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()`` with up to ``retries`` retries on ``retryable`` exceptions,
+    exponential backoff between attempts (``base_delay * 2**attempt``, capped at
+    ``max_delay``). Non-retryable exceptions propagate immediately; the last
+    retryable exception propagates when the budget is exhausted.
+
+    ``on_retry(attempt_index, exc)`` observes each failure before the backoff
+    sleep — loggers and test probes hook here.
+    """
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retryable as e:
+            if attempt >= retries:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            delay = min(base_delay * (2 ** attempt), max_delay)
+            logger.warning(f"[retry] attempt {attempt + 1}/{retries} failed "
+                           f"({type(e).__name__}: {e}); retrying in {delay:.2f}s")
+            sleep(delay)
+            attempt += 1

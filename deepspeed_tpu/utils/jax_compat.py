@@ -1,0 +1,41 @@
+"""JAX API compatibility shims.
+
+The framework targets current JAX surface names; older installed versions spell
+some of them differently. Centralising the translation here keeps kernel and
+model code on ONE spelling:
+
+- ``shard_map``: ``jax.shard_map(f, mesh=, axis_names=, in_specs=, out_specs=,
+  check_vma=)`` (new) vs ``jax.experimental.shard_map.shard_map(f, mesh,
+  in_specs, out_specs, check_rep=, auto=)`` (old). ``axis_names`` lists the
+  MANUAL axes; the old API takes the complement (``auto``) instead, and calls
+  its replication check ``check_rep``.
+"""
+
+from typing import Any, Optional, Set
+
+import jax
+
+_NEW_SHARD_MAP = getattr(jax, "shard_map", None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              axis_names: Optional[Set[Any]] = None, check_vma: bool = False):
+    """New-style ``jax.shard_map`` surface, usable on old JAX too.
+
+    On old JAX the region always runs FULLY manual: partial-auto (non-manual
+    axes left auto) lowers through a PartitionId path the SPMD partitioner
+    rejects — and on some shapes hard-aborts the process — so spec-unmentioned
+    axes are instead treated as replicated through the region (values
+    identical; redundant compute on those axes). Bodies that genuinely need an
+    auto axis inside the region (sharding constraints over ``expert`` in the
+    MoE pipeline body) are unsupported on old JAX and fail loudly at trace.
+    """
+    if _NEW_SHARD_MAP is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return _NEW_SHARD_MAP(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _old
+    return _old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=bool(check_vma))

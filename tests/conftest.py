@@ -30,6 +30,17 @@ def pytest_configure(config):
         "(deselect with -m 'not slow')")
 
 
+def pytest_collection_modifyitems(config, items):
+    """The fault-tolerance lane (crash-consistent checkpoints, kill/restart
+    recovery) must land inside tier-1's wall-clock budget — the full suite can
+    overrun it on CPU, and 'tests/unit/runtime' sorts late alphabetically. Run
+    that file first; relative order of everything else is unchanged."""
+    front = [it for it in items if "test_fault_tolerance" in it.nodeid]
+    if front:
+        rest = [it for it in items if "test_fault_tolerance" not in it.nodeid]
+        items[:] = front + rest
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_mesh():
     """Tests that activate a mesh (engines, shard_map paths) must not leak it into

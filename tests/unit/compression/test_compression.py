@@ -16,6 +16,7 @@ from deepspeed_tpu.compression import (CompressionConfig, channel_mask, head_mas
                                        student_initialization)
 
 from tests.unit.simple_model import base_config, random_batches, simple_model
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 class TestQuantize:
@@ -290,7 +291,7 @@ class TestCompressedAllreduce:
             avg, err = compressed_allreduce(x[0], jnp.zeros_like(x[0]), "data")
             return avg[None], err[None]
 
-        avg, err = jax.jit(jax.shard_map(
+        avg, err = jax.jit(shard_map(
             f, mesh=mesh, in_specs=P("data"), out_specs=P("data")))(local)
         avg = np.asarray(avg)
         # every worker agrees on the compressed average

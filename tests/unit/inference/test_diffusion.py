@@ -282,3 +282,23 @@ class TestTxt2Img:
         assert "tensor" in str(qk.sharding.spec), qk.sharding.spec
         img2 = e2.generate(ids, steps=2, seed=0)
         np.testing.assert_allclose(img2, img1, atol=2e-3)
+
+    def test_engine_does_not_clobber_installed_mesh(self, eight_devices):
+        """ISSUE 1 satellite: constructing a diffusion engine must not swap out
+        another engine's active global mesh — its own shardings are explicit."""
+        from deepspeed_tpu.parallel.mesh import (MeshSpec, get_global_mesh,
+                                                 set_global_mesh)
+        training_mesh = MeshSpec({"data": 2}, eight_devices[:2])
+        set_global_mesh(training_mesh)
+        init_diffusion_inference(
+            synth_unet_sd(UNET), _tiny_clip(), synth_vae_sd(VAE),
+            unet_config=UNET, vae_config=VAE,
+            mesh_spec=MeshSpec({"tensor": 2}, eight_devices[2:4]))
+        assert get_global_mesh() is training_mesh
+        # with the slot free, the engine's mesh installs as before
+        set_global_mesh(None)
+        e = init_diffusion_inference(
+            synth_unet_sd(UNET), _tiny_clip(), synth_vae_sd(VAE),
+            unet_config=UNET, vae_config=VAE,
+            mesh_spec=MeshSpec({"tensor": 2}, eight_devices[:2]))
+        assert get_global_mesh() is e.mesh_spec

@@ -31,10 +31,14 @@ def _greedy_rollout(apply_fn, params, ids, steps):
 
 
 @pytest.mark.parametrize("decode_impl", ["pallas", "xla"])
-def test_serve_trained_moe_model(decode_impl):
+def test_serve_trained_moe_model(decode_impl, monkeypatch):
     """gpt2_moe training params convert and serve through InferenceEngine: the cached MoE
     decode fast path (both the gather-fused kernel and the XLA-gather fallback)
-    reproduces the training model's greedy rollout."""
+    reproduces the training model's greedy rollout.
+
+    ``moe_decode_impl`` rides the inference CONFIG at engine construction (not a
+    post-hoc model_config mutation), and spies on both decode-FFN entry points
+    prove each parametrization exercises ITS implementation."""
     # eval_capacity_factor high enough that the training model's eval path provably drops
     # nothing — serving routes ALL tokens (no capacity, like the reference's inference
     # MoE), so exact parity requires a drop-free training reference
@@ -45,15 +49,48 @@ def test_serve_trained_moe_model(decode_impl):
     params = _train_params(model)
 
     engine = InferenceEngine((cfg, params), ds.inference.DeepSpeedInferenceConfig(
-        dtype="float32", max_out_tokens=64))
-    engine.model_config.moe_decode_impl = decode_impl
+        dtype="float32", max_out_tokens=64, moe_decode_impl=decode_impl))
+    assert engine.model_config.moe_decode_impl == decode_impl
     assert engine.model_config.num_experts == 4
+
+    # spy both entry points (the in-function `from ..ops.moe import ...` resolves
+    # module attributes at trace time, so monkeypatching the package is seen)
+    import deepspeed_tpu.ops.moe as moe_ops
+    calls = []
+    real_pallas, real_xla = moe_ops.moe_decode_ffn, moe_ops.moe_decode_ffn_xla
+
+    def spy(name, real):
+        def wrapped(*a, **k):
+            calls.append(name)
+            return real(*a, **k)
+        return wrapped
+
+    monkeypatch.setattr(moe_ops, "moe_decode_ffn", spy("pallas", real_pallas))
+    monkeypatch.setattr(moe_ops, "moe_decode_ffn_xla", spy("xla", real_xla))
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 96, size=(2, 8)).astype(np.int32)
     out = engine.generate(ids, max_new_tokens=5)
     ref = _greedy_rollout(model.apply_fn, params, ids, 5)
     np.testing.assert_array_equal(out, ref)
+    other = {"pallas": "xla", "xla": "pallas"}[decode_impl]
+    assert decode_impl in calls, f"{decode_impl} impl was never exercised"
+    assert other not in calls, f"wrong impl {other} was exercised"
+
+
+def test_unknown_moe_decode_impl_rejected():
+    """ISSUE 1 satellite: 'XLA' / 'triton' must raise, not silently select the
+    pallas path — at config construction AND through the inference config."""
+    from deepspeed_tpu.models.causal_lm import gpt2_cfg
+    for bad in ("XLA", "triton", "Pallas"):
+        with pytest.raises(ValueError, match="moe_decode_impl"):
+            gpt2_cfg(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=1,
+                     n_head=4, moe_decode_impl=bad)
+    cfg = gpt2_cfg(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=1, n_head=4,
+                   dtype=jnp.float32)
+    with pytest.raises(ValueError, match="moe_decode_impl"):
+        InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+            dtype="float32", max_out_tokens=64, moe_decode_impl="triton"))
 
 
 def test_serve_trained_dense_scan_model():

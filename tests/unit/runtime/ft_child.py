@@ -1,0 +1,71 @@
+"""Child process for the kill-mid-save fault-tolerance test (see
+``test_fault_tolerance.py::TestKillMidSave``).
+
+Phases:
+
+- ``crash``: train 2 steps, commit tag ``good``, record the loss of step 3 (what
+  a resumed run must reproduce bitwise), then start saving tag ``bad`` with a
+  SIGKILL fault armed inside the shard write — the process dies mid-save.
+- ``resume``: fresh engine, ``load_checkpoint`` resolves the latest COMMITTED
+  tag (``good``; the torn ``bad`` staging dir must be ignored), train step 3 on
+  the same batch, record the loss.
+
+The parent asserts the crash really was a SIGKILL, that no partially-visible
+``bad`` tag exists, and that the two recorded losses are bitwise identical.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+sys.path.insert(0, REPO)
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.utils.fault_injection import FaultSpec, inject  # noqa: E402
+
+from tests.unit.simple_model import base_config, random_batches, simple_model  # noqa: E402
+
+
+def build_engine():
+    eng, *_ = deepspeed_tpu.initialize(model=simple_model(16),
+                                       config=base_config(batch_size=16))
+    return eng
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--phase", choices=("crash", "resume"), required=True)
+    args = ap.parse_args()
+
+    batches = random_batches(3, 16, seed=0)
+    eng = build_engine()
+
+    if args.phase == "crash":
+        eng.train_batch(batches[0])
+        eng.train_batch(batches[1])
+        eng.save_checkpoint(args.dir, tag="good")
+        loss3 = float(eng.train_batch(batches[2]))
+        with open(os.path.join(args.dir, "expected.txt"), "w") as f:
+            f.write(repr(loss3))
+        # SIGKILL inside the second shard write of tag 'bad' (after the big
+        # state tree, during client_state) — a preemption landing mid-save
+        inject("ckpt.save.io", FaultSpec(kind="kill", after_n=1)).arm()
+        eng.save_checkpoint(args.dir, tag="bad")
+        sys.exit(7)      # unreachable: the injector killed us
+
+    # resume phase
+    path, _ = eng.load_checkpoint(args.dir)
+    assert path is not None and os.path.basename(path) == "good", path
+    assert eng.global_steps == 2, eng.global_steps
+    loss3 = float(eng.train_batch(batches[2]))
+    with open(os.path.join(args.dir, "resumed.txt"), "w") as f:
+        f.write(repr(loss3))
+
+
+if __name__ == "__main__":
+    main()

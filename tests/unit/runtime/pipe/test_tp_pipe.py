@@ -18,6 +18,7 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.models.gpt2 import GPT2Config, block_tp_apply
 from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
 from deepspeed_tpu.parallel.mesh import MeshSpec
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 TINY = dict(vocab_size=64, n_positions=32, n_embd=32, n_head=4, n_layer=4,
             dropout=0.0, dtype=jnp.float32, split_qkv=True, remat=False,
@@ -51,7 +52,7 @@ class TestTPBlock:
         # tp=1 manual apply outside any mesh: psum over a 1-sized axis via shard_map
         mesh = MeshSpec({"tensor": 1}, jax.devices()[:1])
         fn = block_tp_apply(cfg, 1, "tensor")
-        got = jax.jit(jax.shard_map(lambda pp, xx: fn(pp, xx), mesh=mesh.mesh,
+        got = jax.jit(shard_map(lambda pp, xx: fn(pp, xx), mesh=mesh.mesh,
                                     axis_names={"tensor"}, in_specs=(P(), P()),
                                     out_specs=P(), check_vma=False))(p, x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),

@@ -1,0 +1,434 @@
+"""Fault-tolerance ring: checkpoint crash consistency, fault injection, retry,
+preemption autosave, wedge escalation, launcher restarts (ISSUE 1 tentpole).
+
+Every scenario is driven by the deterministic injection registry
+(``utils/fault_injection.py``) against the REAL save/load paths:
+
+- kill/abort mid-save -> the prior committed tag loads and training resumes
+  with bitwise-identical loss (``validate_determinism``);
+- checksum-corrupted shard -> ``CheckpointCorruptionError`` naming the file;
+- transient I/O error -> the retry policy absorbs it and the save succeeds;
+- duplicated-rank partition set -> consolidation rejects it;
+- a fault at ANY save-path site leaves no partially-visible tag directory.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    CheckpointCorruptionError, MANIFEST_FILE, find_latest_committed_tag,
+    is_committed_tag, validate_manifest)
+from deepspeed_tpu.runtime.engine import CheckpointAutoSaver
+from deepspeed_tpu.utils.debug import validate_determinism
+from deepspeed_tpu.utils.fault_injection import (FaultSpec, faults_fired, inject,
+                                                 reset_faults, retry_with_backoff)
+
+from tests.unit.simple_model import base_config, random_batches, simple_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    reset_faults()
+    yield
+    reset_faults()
+
+
+def _engine():
+    eng, *_ = deepspeed_tpu.initialize(model=simple_model(16),
+                                       config=base_config(batch_size=16))
+    return eng
+
+
+# ---------------------------------------------------------------- registry/retry
+class TestFaultRegistry:
+    def test_io_error_fires_and_counts(self):
+        with inject("x.y", FaultSpec(kind="io_error", message="boom")) as f:
+            with pytest.raises(OSError, match="boom"):
+                deepspeed_tpu.utils.fault_point("x.y")
+            assert f.fired == 1
+        # disarmed: free pass
+        deepspeed_tpu.utils.fault_point("x.y")
+        assert faults_fired("x.y") == 1
+
+    def test_after_n_and_max_faults(self):
+        with inject("s", FaultSpec(after_n=2, max_faults=1)):
+            deepspeed_tpu.utils.fault_point("s")
+            deepspeed_tpu.utils.fault_point("s")      # first 2 hits pass
+            with pytest.raises(OSError):
+                deepspeed_tpu.utils.fault_point("s")  # 3rd fires
+            deepspeed_tpu.utils.fault_point("s")      # budget exhausted
+
+    def test_prob_is_seeded_deterministic(self):
+        def run():
+            reset_faults()
+            outcomes = []
+            with inject("p", FaultSpec(prob=0.5)):
+                for _ in range(16):
+                    try:
+                        deepspeed_tpu.utils.fault_point("p")
+                        outcomes.append(0)
+                    except OSError:
+                        outcomes.append(1)
+            return outcomes
+
+        a, b = run(), run()
+        assert a == b and 0 < sum(a) < 16
+
+    def test_bad_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultSpec(kind="chaos")
+
+    def test_retry_with_backoff(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        seen = []
+        out = retry_with_backoff(flaky, retries=3, base_delay=0.0,
+                                 on_retry=lambda i, e: seen.append(i),
+                                 sleep=lambda s: None)
+        assert out == "ok" and len(attempts) == 3 and seen == [0, 1]
+
+    def test_retry_budget_exhausted(self):
+        def always_fails():
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry_with_backoff(always_fails, retries=1, base_delay=0.0,
+                               sleep=lambda s: None)
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("not io")
+
+        with pytest.raises(ValueError):
+            retry_with_backoff(bad, retries=3, base_delay=0.0, sleep=lambda s: None)
+        assert len(calls) == 1
+
+
+# --------------------------------------------------------------- crash consistency
+class TestCheckpointCrashConsistency:
+    def test_transient_io_error_retried_save_succeeds(self, tmp_path):
+        """Two transient shard-write failures are absorbed by the retry policy;
+        the checkpoint still commits."""
+        eng = _engine()
+        eng.train_batch(random_batches(1, 16)[0])
+        with inject("ckpt.save.io", FaultSpec(kind="io_error", max_faults=2)):
+            path = eng.save_checkpoint(str(tmp_path), tag="t0")
+        assert faults_fired("ckpt.save.io") == 2
+        assert is_committed_tag(str(tmp_path), "t0")
+        validate_manifest(path, strict=True)
+        eng2 = _engine()
+        eng2.load_checkpoint(str(tmp_path))
+        assert eng2.global_steps == 1
+
+    def test_corrupted_shard_raises_naming_file(self, tmp_path):
+        """A bit-flipped shard (same size) fails its SHA-256 at load, and the
+        error names the offending file."""
+        eng = _engine()
+        eng.train_batch(random_batches(1, 16)[0])
+        path = eng.save_checkpoint(str(tmp_path), tag="t0")
+        manifest = json.load(open(os.path.join(path, MANIFEST_FILE)))
+        # corrupt the largest manifested shard in place (size unchanged)
+        victim = max(manifest["files"], key=lambda k: manifest["files"][k]["size"])
+        vpath = os.path.join(path, victim)
+        blob = bytearray(open(vpath, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(vpath, "wb").write(bytes(blob))
+
+        eng2 = _engine()
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            eng2.load_checkpoint(str(tmp_path), tag="t0")
+        assert victim in str(ei.value)
+
+    def test_truncated_shard_raises(self, tmp_path):
+        eng = _engine()
+        eng.train_batch(random_batches(1, 16)[0])
+        path = eng.save_checkpoint(str(tmp_path), tag="t0")
+        manifest = json.load(open(os.path.join(path, MANIFEST_FILE)))
+        victim = max(manifest["files"], key=lambda k: manifest["files"][k]["size"])
+        vpath = os.path.join(path, victim)
+        with open(vpath, "r+b") as fh:
+            fh.truncate(os.path.getsize(vpath) // 2)
+        with pytest.raises(CheckpointCorruptionError, match="truncated"):
+            _engine().load_checkpoint(str(tmp_path), tag="t0")
+
+    def test_mid_save_failure_resumes_bitwise_identical(self, tmp_path):
+        """Abort mid-save of tag B -> B is never visible, 'latest' still names A,
+        and a resumed engine reproduces the post-A step loss BITWISE
+        (validate_determinism over two independent resumes)."""
+        batches = random_batches(3, 16, seed=0)
+        eng = _engine()
+        eng.train_batch(batches[0])
+        eng.train_batch(batches[1])
+        eng.save_checkpoint(str(tmp_path), tag="A")
+        expected_loss = np.asarray(eng.train_batch(batches[2]))
+
+        with inject("ckpt.commit.rename", FaultSpec(kind="io_error")):
+            with pytest.raises(OSError):
+                eng.save_checkpoint(str(tmp_path), tag="B")
+        assert (tmp_path / "latest").read_text() == "A"
+        assert not (tmp_path / "B").exists()
+        assert find_latest_committed_tag(str(tmp_path)) == "A"
+
+        def resume_and_step():
+            e = _engine()
+            path, _ = e.load_checkpoint(str(tmp_path))
+            assert os.path.basename(path) == "A"
+            assert e.global_steps == 2
+            return np.asarray(e.train_batch(batches[2]))
+
+        out = validate_determinism(resume_and_step, n_runs=2)
+        assert np.array_equal(out, expected_loss)
+
+    @pytest.mark.parametrize("site", [
+        "ckpt.save.begin", "ckpt.save", "ckpt.save.io",
+        "ckpt.commit.manifest", "ckpt.manifest.hash", "ckpt.commit.rename",
+        "ckpt.latest",
+    ])
+    def test_atomic_commit_at_every_fault_site(self, tmp_path, site):
+        """The acceptance invariant: a fault at ANY save-path site leaves the
+        new tag either fully committed (valid manifest) or not visible at all —
+        never a partially-visible directory — and the prior tag stays loadable."""
+        eng = _engine()
+        eng.train_batch(random_batches(1, 16)[0])
+        eng.save_checkpoint(str(tmp_path), tag="good")
+
+        with inject(site, FaultSpec(kind="io_error")):
+            try:
+                eng.save_checkpoint(str(tmp_path), tag="next")
+            except OSError:
+                pass
+        tag_dir = tmp_path / "next"
+        if tag_dir.exists():
+            validate_manifest(str(tag_dir), strict=True)   # fully committed
+        else:
+            assert is_committed_tag(str(tmp_path), "good")
+        # resume always works: either tag loads
+        e2 = _engine()
+        path, _ = e2.load_checkpoint(str(tmp_path))
+        assert path is not None and e2.global_steps == 1
+
+    def test_torn_latest_pointer_falls_back(self, tmp_path):
+        """'latest' naming a tag that was never committed falls back to the
+        newest committed tag instead of failing the restart."""
+        eng = _engine()
+        eng.train_batch(random_batches(1, 16)[0])
+        eng.save_checkpoint(str(tmp_path), tag="good")
+        (tmp_path / "latest").write_text("phantom")
+        e2 = _engine()
+        path, _ = e2.load_checkpoint(str(tmp_path))
+        assert os.path.basename(path) == "good"
+
+
+# ------------------------------------------------------------ duplicated ranks
+def _write_partition_file(ckpt_dir, tag, rank, n_ranks, lo, hi, full):
+    """Minimal self-describing partition file (ParamOffloadCoordinator layout):
+    one key 'k' with one 4-element leaf 'w', rank owning full[lo:hi]."""
+    d = os.path.join(ckpt_dir, str(tag))
+    os.makedirs(d, exist_ok=True)
+    meta = {"version": 1, "n_ranks": n_ranks, "rank": rank, "kind": "adamw",
+            "nvme_params": False, "nvme_moments": False,
+            "slots": [{"key": "k", "li": 0, "slice": [[lo, hi]], "owned": True}],
+            "leaf_names": {"k": ["w"]},
+            "leaf_shapes": {"k": [[len(full)]]}}
+    np.savez(os.path.join(d, f"offload_state_part{rank}.npz"),
+             meta_json=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+             step=np.int64(3),
+             master_0=full[lo:hi].astype(np.float32),
+             m_0=np.zeros(hi - lo, np.float32),
+             v_0=np.zeros(hi - lo, np.float32))
+
+
+class TestPartitionConsolidation:
+    def test_duplicated_rank_rejected(self, tmp_path):
+        """Regression (ISSUE 1 satellite): two files claiming the same rank pass
+        the old count-only check but must now be rejected — previously the
+        missing rank's np.empty slices shipped as garbage."""
+        from deepspeed_tpu.checkpoint.export import \
+            consolidate_partitioned_checkpoint
+        full = np.arange(4, dtype=np.float32)
+        _write_partition_file(str(tmp_path / "ck"), "t0", 0, 2, 0, 2, full)
+        # duplicate rank 0 under the part1 filename (the stale-copy scenario:
+        # count-only validation sees 2 files for 2 ranks and passes)
+        src = tmp_path / "ck" / "t0" / "offload_state_part0.npz"
+        dup = tmp_path / "ck" / "t0" / "offload_state_part1.npz"
+        dup.write_bytes(src.read_bytes())
+        with pytest.raises(ValueError, match="duplicate rank 0"):
+            consolidate_partitioned_checkpoint(str(tmp_path / "ck"), "t0",
+                                               str(tmp_path / "out"))
+
+    def test_complete_rank_set_consolidates(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from deepspeed_tpu.checkpoint.export import \
+            consolidate_partitioned_checkpoint
+        full = np.arange(4, dtype=np.float32)
+        _write_partition_file(str(tmp_path / "ck"), "t0", 0, 2, 0, 2, full)
+        _write_partition_file(str(tmp_path / "ck"), "t0", 1, 2, 2, 4, full)
+        out = consolidate_partitioned_checkpoint(str(tmp_path / "ck"), "t0",
+                                                 str(tmp_path / "out"))
+        got = torch.load(os.path.join(out, "zero", "w", "fp32.pt"),
+                         weights_only=False)["param"].numpy()
+        np.testing.assert_array_equal(got, full)
+
+
+# ---------------------------------------------------------------- autosaver
+class TestCheckpointAutoSaver:
+    def test_interval_saving(self, tmp_path):
+        eng = _engine()
+        saver = CheckpointAutoSaver(eng, str(tmp_path), interval_steps=2)
+        saved = []
+        for b in random_batches(4, 16):
+            eng.train_batch(b)
+            p = saver.after_step()
+            if p:
+                saved.append(os.path.basename(p))
+        assert saved == ["global_step2", "global_step4"]
+        assert is_committed_tag(str(tmp_path), "global_step4")
+
+    def test_sigterm_saves_marks_and_exits(self, tmp_path):
+        eng = _engine()
+        eng.train_batch(random_batches(1, 16)[0])
+        saver = CheckpointAutoSaver(eng, str(tmp_path), exit_on_preempt=True)
+        with saver:
+            os.kill(os.getpid(), signal.SIGTERM)
+            # the python-level handler runs at the next bytecode boundary
+            for _ in range(100):
+                if saver.preempted:
+                    break
+                time.sleep(0.01)
+            assert saver.preempted
+            with pytest.raises(SystemExit) as ei:
+                saver.after_step()
+            assert ei.value.code == 128 + signal.SIGTERM
+        marker = tmp_path / CheckpointAutoSaver.PREEMPT_MARKER
+        assert marker.read_text() == "global_step1"
+        assert is_committed_tag(str(tmp_path), "global_step1")
+
+        # restart: resume() loads the preemption checkpoint and clears the marker
+        e2 = _engine()
+        path, _ = CheckpointAutoSaver(e2, str(tmp_path)).resume()
+        assert os.path.basename(path) == "global_step1"
+        assert e2.global_steps == 1
+        assert not marker.exists()
+
+
+# ------------------------------------------------------------ wedge escalation
+class TestWedgeEscalation:
+    def test_wedged_loop_checkpoints_then_raises(self):
+        """The elastic agent's wedge action escalates: checkpoint, then re-raise
+        in the MAIN thread as TrainingWedgedError (restartable failure) instead
+        of an os._exit abort."""
+        from deepspeed_tpu.elasticity import TrainingWedgedError
+        from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+        saved = []
+        agent = DSElasticAgent(
+            {"elasticity": {"enabled": True, "max_train_batch_size": 1000,
+                            "micro_batch_sizes": [2, 4], "version": 0.1}},
+            world_size=2, heartbeat_timeout=0.3,
+            checkpoint_fn=lambda: saved.append(1))
+
+        def wedged_loop(a):
+            time.sleep(30)        # never heartbeats; the watchdog interrupts us
+
+        t0 = time.monotonic()
+        with pytest.raises(TrainingWedgedError, match="wedged"):
+            agent.run(wedged_loop, install_signal_handlers=False)
+        assert saved == [1]
+        assert time.monotonic() - t0 < 25     # interrupted, not slept out
+
+
+# ------------------------------------------------------------ launcher restarts
+class TestLauncherRestarts:
+    def _launch(self, argv):
+        from deepspeed_tpu.launcher import launch
+        with pytest.raises(SystemExit) as ei:
+            launch.main(argv)
+        return int(ei.value.code or 0)
+
+    def test_restart_recovers_transient_failure(self, tmp_path):
+        """Rank fails on attempt 0, succeeds on attempt 1 -> overall success
+        with exactly two attempts (DS_TPU_RESTART_ATTEMPT exposes the count)."""
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import os, sys\n"
+            f"open(os.path.join({str(tmp_path)!r}, "
+            "'a' + os.environ['DS_TPU_RESTART_ATTEMPT']), 'w').close()\n"
+            "sys.exit(1 if os.environ['DS_TPU_RESTART_ATTEMPT'] == '0' else 0)\n")
+        rc = self._launch(["--nproc_per_node=2", "--max_restarts=2",
+                           "--restart_backoff=0.05", str(script)])
+        assert rc == 0
+        assert (tmp_path / "a0").exists() and (tmp_path / "a1").exists()
+        assert not (tmp_path / "a2").exists()
+
+    def test_restart_budget_exhausted_propagates_code(self, tmp_path):
+        script = tmp_path / "dead.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        rc = self._launch(["--nproc_per_node=1", "--max_restarts=1",
+                           "--restart_backoff=0.05", str(script)])
+        assert rc == 3
+
+    def test_no_restart_by_default(self, tmp_path):
+        script = tmp_path / "count.py"
+        script.write_text(
+            "import os, sys\n"
+            f"open(os.path.join({str(tmp_path)!r}, "
+            "'n' + os.environ['DS_TPU_RESTART_ATTEMPT']), 'w').close()\n"
+            "sys.exit(5)\n")
+        rc = self._launch(["--nproc_per_node=1", str(script)])
+        assert rc == 5
+        assert (tmp_path / "n0").exists() and not (tmp_path / "n1").exists()
+
+
+# ----------------------------------------------------------- real SIGKILL lane
+class TestKillMidSave:
+    """Subprocess lane: a REAL SIGKILL lands inside the shard write; the torn
+    tag is invisible, and the restarted process resumes from the committed tag
+    with a bitwise-identical next-step loss. Short subprocess timeouts guard
+    the tier-1 budget (see ft_child.py)."""
+
+    def _run_child(self, ckpt_dir, phase, timeout=240):
+        child = os.path.join(REPO, "tests", "unit", "runtime", "ft_child.py")
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.run(
+            [sys.executable, child, "--dir", str(ckpt_dir), "--phase", phase],
+            capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+    def test_sigkill_mid_save_then_resume(self, tmp_path):
+        crash = self._run_child(tmp_path, "crash")
+        assert crash.returncode == -signal.SIGKILL, \
+            f"expected SIGKILL death, got {crash.returncode}\n" \
+            f"stdout:\n{crash.stdout}\nstderr:\n{crash.stderr}"
+        # the torn tag is not visible; 'latest' still names the committed tag
+        assert (tmp_path / "latest").read_text() == "good"
+        assert not (tmp_path / "bad").exists()
+        assert (tmp_path / "bad.tmp").exists()     # staging garbage, ignored
+        assert is_committed_tag(str(tmp_path), "good")
+
+        resume = self._run_child(tmp_path, "resume")
+        assert resume.returncode == 0, \
+            f"stdout:\n{resume.stdout}\nstderr:\n{resume.stderr}"
+        expected = (tmp_path / "expected.txt").read_text()
+        resumed = (tmp_path / "resumed.txt").read_text()
+        assert resumed == expected, \
+            f"resumed loss {resumed} != pre-kill expectation {expected}"

@@ -84,16 +84,21 @@ class TestSwapFileFaults:
 
 class TestCheckpointFaults:
     def test_corrupt_moments_on_restore_fails_loud(self, tmp_path):
-        """A damaged moments file in a checkpoint (neither the padded IO length
-        nor the exact legacy length) must refuse to restore."""
+        """A damaged moments file in a checkpoint must refuse to restore. The
+        manifest layer now catches it FIRST (truncation named per shard); the
+        tier-level length check remains the backstop when validation is off."""
         eng, _ = _nvme_engine(tmp_path / "swap")
         ckpt = tmp_path / "ckpt"
         eng.save_checkpoint(str(ckpt), tag="t0")
         moments_dir = ckpt / "t0" / "offload_state_moments"
         victim = sorted(moments_dir.iterdir())[0]
         victim.write_bytes(victim.read_bytes()[:100])     # corrupt: 100 bytes
-        with pytest.raises(RuntimeError, match="corrupt moments file"):
+        with pytest.raises(RuntimeError, match="truncated"):
             eng.load_checkpoint(str(ckpt), tag="t0")
+        # backstop: with manifest validation disabled, the moments reader's own
+        # length check still refuses the file
+        with pytest.raises(RuntimeError, match="corrupt moments file"):
+            eng.load_checkpoint(str(ckpt), tag="t0", validate=False)
 
     def test_missing_master_on_restore_fails_loud(self, tmp_path):
         eng, _ = _nvme_engine(tmp_path / "swap")
@@ -101,8 +106,10 @@ class TestCheckpointFaults:
         eng.save_checkpoint(str(ckpt), tag="t0")
         masters_dir = ckpt / "t0" / "offload_state_masters"
         sorted(masters_dir.iterdir())[0].unlink()
-        with pytest.raises(RuntimeError, match="missing master file"):
+        with pytest.raises(RuntimeError, match="missing"):
             eng.load_checkpoint(str(ckpt), tag="t0")
+        with pytest.raises(RuntimeError, match="missing master file"):
+            eng.load_checkpoint(str(ckpt), tag="t0", validate=False)
 
     def test_crash_before_latest_keeps_previous_tag(self, tmp_path, monkeypatch):
         """Commit-before-latest ordering: kill the save between the data write
