@@ -98,15 +98,25 @@ class CSVConfig(ConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class JSONLConfig(ConfigModel):
+    """Scrape-free metrics for serving runs: one JSON object per event, one file
+    per job (TPU addition — no reference analogue)."""
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
 class MonitorConfig(ConfigModel):
-    """Reference ``monitor/config.py``."""
+    """Reference ``monitor/config.py`` (+ TPU-native ``jsonl_monitor`` backend)."""
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    jsonl_monitor: JSONLConfig = Field(default_factory=JSONLConfig)
 
     @property
     def enabled(self) -> bool:
-        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+        return (self.tensorboard.enabled or self.wandb.enabled
+                or self.csv_monitor.enabled or self.jsonl_monitor.enabled)
 
 
 class FlopsProfilerConfig(ConfigModel):
@@ -193,6 +203,7 @@ class DeepSpeedConfig:
             tensorboard=pd.get(C.MONITOR_TENSORBOARD, {}),
             wandb=pd.get(C.MONITOR_WANDB, {}),
             csv_monitor=pd.get(C.MONITOR_CSV, {}),
+            jsonl_monitor=pd.get(C.MONITOR_JSONL, {}),
         )
         self.flops_profiler = FlopsProfilerConfig(**pd.get(C.FLOPS_PROFILER, {}))
         self.pipeline = PipelineConfig(**pd.get(C.PIPELINE, {}))

@@ -71,6 +71,7 @@ COMMS_LOGGER = "comms_logger"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
+MONITOR_JSONL = "jsonl_monitor"
 FLOPS_PROFILER = "flops_profiler"
 ELASTICITY = "elasticity"
 COMPRESSION_TRAINING = "compression_training"

@@ -1,0 +1,182 @@
+"""Shared compiled-step builders for generation: prefill, whole-batch decode loop,
+and fixed-shape chunked decode.
+
+This is the factored-out core of ``InferenceEngine._loop_fns``: the single-call
+``generate`` path keeps its one-``lax.while_loop``-per-call shape (the XLA analogue
+of CUDA-graph replay), while the serving executor composes the same prefill with
+:func:`build_decode_chunk` — K fixed steps over a fixed slot-batch, returning to the
+host between chunks so the continuous-batching scheduler can admit/retire requests
+mid-stream. Both paths share the token-selection closures here, so sampling
+semantics cannot drift between them.
+
+Key-stream contract: the batched :func:`make_select_fn` draws ONE key per step for
+the whole batch (cheap, but a row's sample depends on its batch position);
+:func:`make_slot_select_fn` folds a per-slot ``(seed, step)`` into the base key, so
+a request's sampled tokens are a pure function of its own seed and token index —
+independent of which KV slot it lands in and of who shares the slot-batch. Serving
+needs the latter: continuous batching re-binds requests to slots arbitrarily.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def logits_transform(do_sample: bool, temperature: float, top_k: int,
+                     top_p: float) -> Callable[[Any], Any]:
+    """Temperature/top-k/top-p masking over ``(b, V)`` logits (sampling only)."""
+
+    def transform(x):
+        x = x / jnp.maximum(temperature, 1e-6)
+        if top_k and top_k > 0:
+            kth = jnp.sort(x, axis=-1)[:, -top_k][:, None]
+            x = jnp.where(x < kth, -jnp.inf, x)
+        if top_p < 1.0:
+            sorted_logits = jnp.sort(x, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+            x = jnp.where(x < cutoff, -jnp.inf, x)
+        return x
+
+    return transform
+
+
+def make_select_fn(do_sample: bool, temperature: float, top_k: int, top_p: float):
+    """``(b, V)`` logits + one shared key → ``(b, 1)`` tokens (generate path)."""
+    transform = logits_transform(do_sample, temperature, top_k, top_p)
+
+    def select(logits, rng):
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1)[:, None].astype(jnp.int32)
+        return jax.random.categorical(rng, transform(logits),
+                                      axis=-1)[:, None].astype(jnp.int32)
+
+    return select
+
+
+def make_slot_select_fn(do_sample: bool, temperature: float, top_k: int,
+                        top_p: float):
+    """``(S, V)`` logits + per-slot ``(seed, step)`` → ``(S, 1)`` tokens.
+
+    Greedy is slot-independent by construction; sampling folds each slot's seed and
+    per-request step counter into the base key so co-batched requests never share a
+    key stream.
+    """
+    transform = logits_transform(do_sample, temperature, top_k, top_p)
+
+    def select(logits, base_key, seeds, steps):
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1)[:, None].astype(jnp.int32)
+        x = transform(logits)
+
+        def one(row, seed, step):
+            key = jax.random.fold_in(jax.random.fold_in(base_key, seed), step)
+            return jax.random.categorical(key, row)
+
+        return jax.vmap(one)(x, seeds, steps)[:, None].astype(jnp.int32)
+
+    return select
+
+
+def build_prefill(module, dequant):
+    """Prefill: one forward over the (right-padded) prompt, logits read only at each
+    sequence's last valid position (``logits_positions`` skips the rest of the head
+    matmul), KV written into the fixed cache buffers."""
+
+    def prefill(params, ids, caches, lens0):
+        logits, new_caches = module.apply(
+            {"params": dequant(params)}, ids, caches=caches,
+            cache_lens=jnp.zeros_like(lens0),
+            logits_positions=jnp.maximum(lens0 - 1, 0))
+        return logits[:, 0], new_caches
+
+    return prefill
+
+
+def build_decode_loop(module, dequant, select, gen_cap: int):
+    """Whole-batch run-to-completion decode: ONE ``lax.while_loop`` for all remaining
+    tokens, EOS termination as an on-device reduction in the loop condition
+    (``InferenceEngine.generate``'s decode shape)."""
+
+    def decode_loop(params, tok0, caches, lens, n_new, eos, rng):
+        b = tok0.shape[0]
+        buf = jnp.zeros((b, gen_cap), jnp.int32).at[:, 0].set(tok0[:, 0])
+        finished0 = tok0[:, 0] == eos          # eos = -1 when unused: never matches
+
+        def cond(s):
+            i, _, _, _, finished, _ = s
+            return jnp.logical_and(i < n_new, jnp.logical_not(jnp.all(finished)))
+
+        def body(s):
+            i, tok, caches, lens, finished, buf = s
+            positions = lens[:, None]
+            logits, caches = module.apply(
+                {"params": dequant(params)}, tok, positions=positions,
+                caches=caches, cache_lens=lens)
+            tok = select(logits[:, -1], jax.random.fold_in(rng, i))
+            # finished sequences keep emitting eos (HF pad-with-eos behaviour)
+            tok = jnp.where(finished[:, None], jnp.maximum(eos, 0), tok)
+            finished = jnp.logical_or(finished, tok[:, 0] == eos)
+            buf = buf.at[:, i].set(tok[:, 0])
+            return i + 1, tok, caches, lens + 1, finished, buf
+
+        # lens is each sequence's append position: the prompt's true length (generated
+        # tokens overwrite right-pad slots in the cache; decode masks by cache_len)
+        state = (jnp.int32(1), tok0, caches, lens, finished0, buf)
+        n, _, _, _, _, buf = jax.lax.while_loop(cond, body, state)
+        return buf, n
+
+    return decode_loop
+
+
+def build_decode_chunk(module, dequant, slot_select, chunk_size: int):
+    """Fixed-shape chunked decode over a slot-batch: exactly ``chunk_size`` steps,
+    every shape static, one compile per (slots, cap, chunk, sampling) key.
+
+    Per-slot state (all ``(S,)`` unless noted):
+
+    - ``toks (S, 1)``: each slot's last emitted token (the next step's input);
+    - ``lens``: the slot's KV append position — advances only while the slot is
+      active, so a retired slot's cache rows below ``lens`` stay intact until the
+      pool zero-fills it;
+    - ``active``: slot holds a live, unfinished request. Inactive slots still flow
+      through the batch (fixed shapes) but emit ``max(eos, 0)`` and freeze;
+    - ``remaining``: decode-token budget (prefill's first token already spent);
+    - ``eos_ids``: per-request EOS (−1 = none, never matches);
+    - ``seeds`` / ``steps``: per-request sampling stream coordinates.
+
+    A slot's real tokens in the returned ``buf (S, chunk_size)`` are the prefix of
+    length ``steps_out[s] - steps_in[s]`` — active→inactive is one-way inside a
+    chunk, so no gaps. The scheduler harvests on the host between chunks.
+    """
+
+    def decode_chunk(params, toks, caches, lens, active, remaining, eos_ids,
+                     seeds, steps, base_key):
+        S = toks.shape[0]
+        buf = jnp.zeros((S, chunk_size), jnp.int32)
+
+        def body(i, s):
+            toks, caches, lens, active, remaining, steps, buf = s
+            logits, caches = module.apply(
+                {"params": dequant(params)}, toks, positions=lens[:, None],
+                caches=caches, cache_lens=lens)
+            nxt = slot_select(logits[:, -1], base_key, seeds, steps)
+            tok = jnp.where(active[:, None], nxt,
+                            jnp.maximum(eos_ids, 0)[:, None]).astype(jnp.int32)
+            buf = buf.at[:, i].set(tok[:, 0])
+            remaining = remaining - active.astype(jnp.int32)
+            finished = jnp.logical_or(tok[:, 0] == eos_ids, remaining <= 0)
+            lens = lens + active.astype(jnp.int32)
+            steps = steps + active.astype(jnp.int32)
+            active = jnp.logical_and(active, jnp.logical_not(finished))
+            return tok, caches, lens, active, remaining, steps, buf
+
+        toks, caches, lens, active, remaining, steps, buf = jax.lax.fori_loop(
+            0, chunk_size, body,
+            (toks, caches, lens, active, remaining, steps, buf))
+        return buf, toks, caches, lens, active, remaining, steps
+
+    return decode_chunk

@@ -27,6 +27,7 @@ from ..models.causal_lm import (CausalLM, CausalLMConfig, causal_lm_param_specs,
 from ..parallel.mesh import AXIS_DATA, AXIS_TENSOR, MeshSpec, set_global_mesh
 from ..utils.logging import log_dist, logger
 from .config import DeepSpeedInferenceConfig
+from .decode_fns import build_decode_loop, build_prefill, make_select_fn
 
 
 def spec_fits(mesh_spec, shape, spec) -> bool:
@@ -78,6 +79,10 @@ class InferenceEngine:
         self._shard_params()
         self._fns: Dict[str, Any] = {}
         self.ttft: Optional[float] = None
+        self.tpot: Optional[float] = None          # seconds per decode token (per seq)
+        self.decode_tps: Optional[float] = None    # decode tokens/sec across the batch
+        self._monitor = None                       # optional MonitorMaster
+        self._gen_count = 0
         log_dist(f"inference engine ready: {self.model_config.name} "
                  f"params≈{self.model_config.num_params():,} tp={tp} dp={dp} "
                  f"dtype={self.dtype.__name__}", ranks=[0])
@@ -202,51 +207,26 @@ class InferenceEngine:
         """Device-resident generation: prefill (first token, synced for TTFT) + ONE compiled
         ``lax.while_loop`` for all remaining tokens — the XLA analogue of CUDA-graph replay
         (reference ``_create_cuda_graph:479``) with zero host round-trips in the decode loop;
-        EOS termination is an on-device all-reduce in the loop condition."""
+        EOS termination is an on-device all-reduce in the loop condition.
+
+        The step bodies live in ``decode_fns`` (``build_prefill``/``build_decode_loop``),
+        shared with the serving executor's chunked variant (``build_decode_chunk``) so the
+        two decode paths cannot drift."""
         key = ("loop", do_sample, float(temperature), int(top_k), float(top_p), gen_cap)
         if key in self._fns:
             return self._fns[key]
-        module = self.module
         select = self._select_fn(do_sample, temperature, top_k, top_p)
+        prefill_logits = build_prefill(self.module, self._dequant)
 
         def prefill(params, ids, caches, lens0, rng):
             # ids may be right-padded: next-token logits are computed ONLY at each
             # sequence's last *valid* position (logits_positions skips the other
             # t-1 rows of the huge head matmul — a 250k-vocab 7B prompt's TTFT is
             # dominated by it otherwise)
-            logits, new_caches = module.apply(
-                {"params": self._dequant(params)}, ids, caches=caches,
-                cache_lens=jnp.zeros_like(lens0),
-                logits_positions=jnp.maximum(lens0 - 1, 0))
-            return select(logits[:, 0], rng), new_caches, lens0
+            logits, new_caches = prefill_logits(params, ids, caches, lens0)
+            return select(logits, rng), new_caches, lens0
 
-        def decode_loop(params, tok0, caches, lens, n_new, eos, rng):
-            b = tok0.shape[0]
-            buf = jnp.zeros((b, gen_cap), jnp.int32).at[:, 0].set(tok0[:, 0])
-            finished0 = tok0[:, 0] == eos          # eos = -1 when unused: never matches
-
-            def cond(s):
-                i, _, _, _, finished, _ = s
-                return jnp.logical_and(i < n_new, jnp.logical_not(jnp.all(finished)))
-
-            def body(s):
-                i, tok, caches, lens, finished, buf = s
-                positions = lens[:, None]
-                logits, caches = module.apply(
-                    {"params": self._dequant(params)}, tok, positions=positions,
-                    caches=caches, cache_lens=lens)
-                tok = select(logits[:, -1], jax.random.fold_in(rng, i))
-                # finished sequences keep emitting eos (HF pad-with-eos behaviour)
-                tok = jnp.where(finished[:, None], jnp.maximum(eos, 0), tok)
-                finished = jnp.logical_or(finished, tok[:, 0] == eos)
-                buf = buf.at[:, i].set(tok[:, 0])
-                return i + 1, tok, caches, lens + 1, finished, buf
-
-            # lens is each sequence's append position: the prompt's true length (generated
-            # tokens overwrite right-pad slots in the cache; decode masks by cache_len)
-            state = (jnp.int32(1), tok0, caches, lens, finished0, buf)
-            n, _, _, _, _, buf = jax.lax.while_loop(cond, body, state)
-            return buf, n
+        decode_loop = build_decode_loop(self.module, self._dequant, select, gen_cap)
 
         # No donation on either fn: prefill rebuilds cache buffers (pad-write) and the loop
         # reuses its carry buffers internally — donating caches cannot alias any output
@@ -257,26 +237,16 @@ class InferenceEngine:
 
     def _select_fn(self, do_sample, temperature, top_k, top_p):
         """Token-selection closure shared by the generation paths."""
-
-        def select(logits, rng):
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1)[:, None]
-            x = logits / jnp.maximum(temperature, 1e-6)
-            if top_k and top_k > 0:
-                kth = jnp.sort(x, axis=-1)[:, -top_k][:, None]
-                x = jnp.where(x < kth, -jnp.inf, x)
-            if top_p < 1.0:
-                sorted_logits = jnp.sort(x, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-                x = jnp.where(x < cutoff, -jnp.inf, x)
-            return jax.random.categorical(rng, x, axis=-1)[:, None]
-
-        return select
+        return make_select_fn(do_sample, temperature, top_k, top_p)
 
     # ------------------------------------------------------------------ API
+    def set_monitor(self, monitor):
+        """Attach a :class:`~deepspeed_tpu.monitor.MonitorMaster`; every ``generate``
+        then emits ``inference/ttft_ms``, ``inference/tpot_ms`` and
+        ``inference/decode_tokens_per_sec`` events (step = generate-call index)."""
+        self._monitor = monitor
+        return self
+
     def _activate(self):
         # engines may coexist (e.g. tp=1 and tp=4); tracing consults the global mesh, so
         # re-assert ours before any compiled-fn call
@@ -365,10 +335,28 @@ class InferenceEngine:
         eos = np.int32(-1 if eos_token_id is None else eos_token_id)
         # cache room is guaranteed: cap >= t + max_new_tokens, and the last appended KV
         # lands at position t + max_new_tokens - 2 < cap
+        t1 = time.perf_counter()
         buf, n = decode_loop(self.params, tok0, caches, lens,
                              np.int32(max_new_tokens), eos, rng)
         n = int(n)
-        gen = np.asarray(buf)[:, :n]
+        gen = np.asarray(buf)[:, :n]                    # host sync ends the decode clock
+        decode_time = time.perf_counter() - t1
+        # TPOT counts only loop-produced tokens (the first token is TTFT's);
+        # decode_tps is batch-aggregate throughput of the same window
+        if n > 1 and decode_time > 0:
+            self.tpot = decode_time / (n - 1)
+            self.decode_tps = b * (n - 1) / decode_time
+        else:
+            self.tpot = None
+            self.decode_tps = None
+        self._gen_count += 1
+        if self._monitor is not None and getattr(self._monitor, "enabled", False):
+            events = [("inference/ttft_ms", self.ttft * 1e3, self._gen_count)]
+            if self.tpot is not None:
+                events += [("inference/tpot_ms", self.tpot * 1e3, self._gen_count),
+                           ("inference/decode_tokens_per_sec", self.decode_tps,
+                            self._gen_count)]
+            self._monitor.write_events(events)
         return np.concatenate([ids, gen], axis=1)
 
     # ------------------------------------------------------------------ checkpoints

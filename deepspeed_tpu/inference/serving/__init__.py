@@ -1,0 +1,28 @@
+"""TPU-native serving subsystem: continuous batching over a slot-recycled KV pool.
+
+Layers (bottom-up):
+
+- :mod:`kv_pool` — :class:`SlotKVPool`: slot-indexed fixed-capacity KV buffers
+  built on ``init_cache``; scatter-in prefill, zero-fill on release, donated
+  updates throughout;
+- :mod:`executor` — :class:`ChunkedDecodeExecutor`: compiled fixed-shape decode
+  chunks of K steps over the slot-batch (one compile per (slots, cap, chunk,
+  sampling) key), per-slot prefill bucketed by prompt length;
+- :mod:`scheduler` — :class:`ContinuousBatchingScheduler`: bounded request queue
+  with admission control, backpressure (reject-with-retry-after), deadlines,
+  cancellation, and slot recycling between chunks;
+- :mod:`telemetry` — :class:`ServingTelemetry`: per-request TTFT/TPOT, queue
+  depth, slot occupancy and tokens/sec through ``MonitorMaster``.
+"""
+
+from .executor import ChunkedDecodeExecutor
+from .kv_pool import SlotKVPool
+from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
+                        RequestHandle, RequestState, ServingConfig)
+from .telemetry import ServingTelemetry
+
+__all__ = [
+    "ChunkedDecodeExecutor", "SlotKVPool", "ContinuousBatchingScheduler",
+    "QueueFullError", "RequestHandle", "RequestState", "ServingConfig",
+    "ServingTelemetry",
+]

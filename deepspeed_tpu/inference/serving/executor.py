@@ -1,0 +1,168 @@
+"""Chunked decode executor: compiled fixed-shape chunks over a slot-batch.
+
+The refactored form of ``InferenceEngine._loop_fns``: instead of one
+run-to-completion ``lax.while_loop`` per user call, decode runs in chunks of K
+steps over a fixed slot-batch and returns to the host between chunks — the host
+window in which the continuous-batching scheduler retires finished requests,
+recycles their KV slots and prefills pending prompts, while the other slots keep
+decoding. Compile-key discipline:
+
+- ONE decode-chunk compile per (slots, cap, chunk, sampling) key, cached on the
+  owning engine's ``_fns`` so coexisting executors share it;
+- ONE prefill compile per (prompt-bucket, cap, sampling) key — prompts are
+  right-padded to power-of-two buckets so arbitrary lengths hit a handful of
+  compiles.
+
+KV buffers are donated unconditionally (chunk in-place-updates the pool rows;
+jax 0.4.37 honours ``donate_argnums`` on CPU too — no backend guards).
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.causal_lm import init_cache
+from ..decode_fns import build_decode_chunk, build_prefill, make_slot_select_fn
+from .kv_pool import SlotKVPool
+
+
+def prompt_buckets(max_prompt_len: int, smallest: int = 8) -> Tuple[int, ...]:
+    """Power-of-two right-pad buckets covering ``[1, max_prompt_len]``."""
+    buckets = []
+    b = smallest
+    while b < max_prompt_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prompt_len)
+    return tuple(buckets)
+
+
+@dataclass
+class ChunkResult:
+    """Host view of one decode chunk (everything already fetched)."""
+    buf: np.ndarray          # (S, K) emitted tokens; per-slot real prefix only
+    toks: np.ndarray         # (S, 1) each slot's last token
+    lens: np.ndarray         # (S,) KV append positions
+    active: np.ndarray       # (S,) bool
+    remaining: np.ndarray    # (S,) decode budget left
+    steps: np.ndarray        # (S,) per-request tokens emitted so far
+    elapsed: float           # wall seconds for dispatch + fetch
+
+
+class ChunkedDecodeExecutor:
+    """Drives prefill-into-slot + K-step decode chunks for a scheduler."""
+
+    def __init__(self, engine, slots: int, cap: int, chunk_size: int,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, max_prompt_len: Optional[int]
+                 = None, base_seed: int = 0):
+        if chunk_size < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+        self.engine = engine
+        self.slots = int(slots)
+        self.cap = int(cap)
+        self.chunk_size = int(chunk_size)
+        self.max_prompt_len = int(max_prompt_len or cap - 1)
+        if self.max_prompt_len >= self.cap:
+            raise ValueError("max_prompt_len must leave room for at least one "
+                             f"generated token (cap={self.cap})")
+        self.sampling = (bool(do_sample), float(temperature), int(top_k),
+                         float(top_p))
+        self.buckets = prompt_buckets(self.max_prompt_len)
+        self.pool = SlotKVPool(engine.model_config, self.slots, self.cap,
+                               dtype=engine.dtype)
+        self._slot_select = make_slot_select_fn(*self.sampling)
+        self._base_key = jax.random.PRNGKey(base_seed)
+
+    def reset_pool(self) -> None:
+        """Discard the pool (e.g. after a failed dispatch that may have consumed
+        donated buffers) and rebuild it fresh, every slot free."""
+        self.pool = SlotKVPool(self.engine.model_config, self.slots, self.cap,
+                               dtype=self.engine.dtype)
+
+    # ------------------------------------------------------------- compiled fns
+    def _chunk_fn(self):
+        key = ("serve_chunk", self.slots, self.cap, self.chunk_size, self.sampling)
+        fns = self.engine._fns
+        if key not in fns:
+            chunk = build_decode_chunk(self.engine.module, self.engine._dequant,
+                                       self._slot_select, self.chunk_size)
+            fns[key] = jax.jit(chunk, donate_argnums=(2,))   # caches
+        return fns[key]
+
+    def _prefill_fn(self, bucket: int):
+        key = ("serve_prefill", bucket, self.cap, self.sampling)
+        fns = self.engine._fns
+        if key not in fns:
+            engine = self.engine
+            prefill_logits = build_prefill(engine.module, engine._dequant)
+            select = self._slot_select
+            cfg = engine.model_config
+            cap, dtype = self.cap, engine.dtype
+
+            def prefill(params, ids, len0, seed, base_key):
+                caches = init_cache(cfg, 1, cap, dtype=dtype)
+                logits, new_caches = prefill_logits(params, ids, caches, len0)
+                tok0 = select(logits, base_key, seed, jnp.zeros_like(seed))
+                return tok0, new_caches
+
+            fns[key] = jax.jit(prefill)
+        return fns[key]
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(f"prompt length {prompt_len} exceeds max_prompt_len="
+                         f"{self.max_prompt_len}")
+
+    # -------------------------------------------------------------------- steps
+    def prefill_into_slot(self, slot: int, prompt: np.ndarray, seed: int = 0
+                          ) -> Tuple[int, float]:
+        """Prefill ``prompt`` (1-D int tokens) and scatter its KV into ``slot``.
+
+        Returns ``(first_token, prefill_seconds)`` — the first token is
+        host-synced before the clock stops, so the scheduler's TTFT is honest.
+        """
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        t = prompt.shape[0]
+        bucket = self.bucket_for(t)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = prompt
+        self.engine._activate()
+        fn = self._prefill_fn(bucket)
+        t0 = time.perf_counter()
+        tok0, one_caches = fn(self.engine.params, jnp.asarray(ids),
+                              jnp.asarray([t], jnp.int32),
+                              jnp.asarray([seed], jnp.int32), self._base_key)
+        tok0 = int(np.asarray(tok0)[0, 0])              # host sync: honest TTFT
+        dt = time.perf_counter() - t0
+        self.pool.scatter_prefill(slot, one_caches)
+        return tok0, dt
+
+    def run_chunk(self, toks: np.ndarray, lens: np.ndarray, active: np.ndarray,
+                  remaining: np.ndarray, eos_ids: np.ndarray, seeds: np.ndarray,
+                  steps: np.ndarray) -> ChunkResult:
+        """One K-step compiled chunk over the slot-batch; pool caches are donated
+        in and rebound from the output. All other state is host numpy."""
+        self.engine._activate()
+        fn = self._chunk_fn()
+        t0 = time.perf_counter()
+        out = fn(self.engine.params, jnp.asarray(toks, jnp.int32).reshape(-1, 1),
+                 self.pool.caches, jnp.asarray(lens, jnp.int32),
+                 jnp.asarray(active, bool), jnp.asarray(remaining, jnp.int32),
+                 jnp.asarray(eos_ids, jnp.int32), jnp.asarray(seeds, jnp.int32),
+                 jnp.asarray(steps, jnp.int32), self._base_key)
+        buf, toks_d, caches, lens_d, active_d, remaining_d, steps_d = out
+        self.pool.caches = caches
+        res = ChunkResult(buf=np.asarray(buf), toks=np.asarray(toks_d),
+                          lens=np.asarray(lens_d), active=np.asarray(active_d),
+                          remaining=np.asarray(remaining_d),
+                          steps=np.asarray(steps_d),
+                          elapsed=0.0)
+        res.elapsed = time.perf_counter() - t0          # after host fetches
+        return res

@@ -1,0 +1,367 @@
+"""Continuous-batching scheduler: bounded queue → slot-recycled decode chunks.
+
+The serving loop above ``InferenceEngine``'s single-call ``generate``: requests
+arrive at any time, wait in a bounded FIFO queue, are prefilled into a free KV
+slot between decode chunks, and decode alongside whatever else is in flight. A
+finished sequence releases its slot at the next chunk boundary and a pending
+prompt is prefilled into it while the other slots keep decoding — continuous
+batching in the sense of Orca/vLLM, built from two compiled shapes (bucketed
+prefill + K-step chunk) instead of a token-level iteration.
+
+Semantics:
+
+- **admission control** — ``submit`` validates prompt/budget against the pool cap
+  up front (fail fast, never poison the queue);
+- **backpressure** — a full queue raises :class:`QueueFullError` carrying a
+  ``retry_after`` hint: the request is *rejected*, never silently dropped;
+- **deadlines / cancellation** — checked at every chunk boundary, for queued and
+  in-flight requests alike; an expired/cancelled in-flight request keeps its
+  partial tokens and frees its slot;
+- **transient faults** — prefill and chunk dispatch run under
+  ``retry_with_backoff`` with ``fault_point`` sites ``serving.prefill`` /
+  ``serving.decode_chunk``, the same injection substrate as the checkpoint ring.
+
+Token parity: greedy decode through the scheduler is bit-identical to per-request
+``InferenceEngine.generate`` (same prefill math, same per-step decode math —
+shared via ``decode_fns``). Sampled decode is deterministic per request ``seed``
+and independent of slot placement/co-batching (per-slot key streams), but is not
+bit-identical to ``generate``'s batched key stream.
+
+Threading: the scheduler is single-threaded by design — drive it with ``step()``
+/ ``run()`` from one thread (the loadgen and ``deepspeed-serve`` do exactly
+that). ``RequestHandle.cancel`` only sets a flag and is safe to call from
+anywhere.
+"""
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from ...utils.fault_injection import fault_point, retry_with_backoff
+from ...utils.logging import logger
+from .executor import ChunkedDecodeExecutor
+from .telemetry import ServingTelemetry
+
+
+class RequestState(Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    EXPIRED = "expired"
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the admission queue is at capacity. ``retry_after`` is the
+    scheduler's hint (seconds) for when to resubmit."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(f"serving queue full; retry after {retry_after:.3f}s")
+        self.retry_after = float(retry_after)
+
+
+@dataclass
+class ServingConfig:
+    slots: int = 2                      # concurrent sequences in the slot-batch
+    chunk_size: int = 8                 # decode steps per compiled chunk
+    max_queue: int = 16                 # admission queue bound (backpressure)
+    max_seq_len: Optional[int] = None   # KV cap; default engine max_out_tokens
+    max_prompt_len: Optional[int] = None
+    default_max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    retry_after_s: float = 0.25         # backpressure hint
+    transient_retries: int = 2          # retry_with_backoff budget per dispatch
+    retry_base_delay: float = 0.02
+    base_seed: int = 0
+
+
+@dataclass
+class RequestHandle:
+    """Caller's view of a submitted request (filled in by the scheduler)."""
+    id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    deadline_s: Optional[float]
+    seed: int
+    arrival: float
+    state: RequestState = RequestState.QUEUED
+    tokens: List[int] = field(default_factory=list)
+    ttft: Optional[float] = None        # queue wait + prefill, seconds
+    tpot: Optional[float] = None        # seconds per decode token
+    finish_reason: Optional[str] = None  # eos | length | cancelled | deadline
+    slot: Optional[int] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    _cancel: bool = False
+
+    def cancel(self) -> None:
+        self._cancel = True
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.EXPIRED)
+
+    def result(self) -> np.ndarray:
+        """Generated tokens (EOS included when emitted; partial if cancelled)."""
+        return np.asarray(self.tokens, dtype=np.int32)
+
+    def output_ids(self) -> np.ndarray:
+        return np.concatenate([self.prompt.astype(np.int32), self.result()])
+
+
+class ContinuousBatchingScheduler:
+    """Admission queue + slot tables driving a :class:`ChunkedDecodeExecutor`."""
+
+    def __init__(self, engine, config: Optional[ServingConfig] = None,
+                 monitor=None):
+        self.config = cfg = config or ServingConfig()
+        cap = int(cfg.max_seq_len or engine._config.max_out_tokens)
+        self.executor = ChunkedDecodeExecutor(
+            engine, slots=cfg.slots, cap=cap, chunk_size=cfg.chunk_size,
+            do_sample=cfg.do_sample, temperature=cfg.temperature,
+            top_k=cfg.top_k, top_p=cfg.top_p,
+            max_prompt_len=cfg.max_prompt_len, base_seed=cfg.base_seed)
+        self.cap = cap
+        self.telemetry = ServingTelemetry(monitor)
+        self.queue: Deque[RequestHandle] = deque()
+        self._ids = itertools.count()
+        S = cfg.slots
+        self._slot_req: List[Optional[RequestHandle]] = [None] * S
+        self._toks = np.zeros(S, np.int32)
+        self._lens = np.zeros(S, np.int32)
+        self._active = np.zeros(S, bool)
+        self._remaining = np.zeros(S, np.int32)
+        self._eos = np.full(S, -1, np.int32)
+        self._seeds = np.zeros(S, np.int32)
+        self._steps = np.zeros(S, np.int32)
+
+    # ---------------------------------------------------------------- frontend
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None, seed: int = 0
+               ) -> RequestHandle:
+        """Enqueue a request. Raises ``ValueError`` on inadmissible shapes and
+        :class:`QueueFullError` (with ``retry_after``) under backpressure."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        max_new = int(self.config.default_max_new_tokens
+                      if max_new_tokens is None else max_new_tokens)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if prompt.size > self.executor.max_prompt_len:
+            raise ValueError(f"prompt length {prompt.size} exceeds "
+                             f"max_prompt_len={self.executor.max_prompt_len}")
+        if prompt.size + max_new > self.cap:
+            raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
+                             f"({max_new}) exceeds KV capacity {self.cap}")
+        if len(self.queue) >= self.config.max_queue:
+            self.telemetry.on_rejected()
+            raise QueueFullError(self.config.retry_after_s)
+        handle = RequestHandle(
+            id=next(self._ids), prompt=prompt, max_new_tokens=max_new,
+            eos_token_id=eos_token_id, deadline_s=deadline_s, seed=int(seed),
+            arrival=time.monotonic())
+        self.queue.append(handle)
+        return handle
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def active_requests(self) -> List[RequestHandle]:
+        return [h for h in self._slot_req if h is not None]
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.queue) or any(h is not None for h in self._slot_req)
+
+    # ------------------------------------------------------------------- loop
+    def step(self) -> bool:
+        """One scheduler iteration: sweep deadlines/cancellations, admit pending
+        prompts into free slots, run one decode chunk, retire finished slots.
+        Returns True when any request made progress."""
+        now = time.monotonic()
+        self._sweep_queue(now)
+        self._sweep_running(now)
+        admitted = self._admit()
+        decoded = self._decode_chunk()
+        self.telemetry.on_step(len(self.queue), self.executor.pool.occupancy)
+        return admitted or decoded
+
+    def run(self, max_steps: int = 100000) -> dict:
+        """Drive ``step()`` until queue and slots drain; returns the telemetry
+        snapshot."""
+        steps = 0
+        while self.busy and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.telemetry.snapshot()
+
+    # ----------------------------------------------------------------- sweeps
+    def _expired(self, handle: RequestHandle, now: float) -> bool:
+        return (handle.deadline_s is not None
+                and now - handle.arrival > handle.deadline_s)
+
+    def _sweep_queue(self, now: float) -> None:
+        kept = deque()
+        for h in self.queue:
+            if h._cancel:
+                self._finalize(h, RequestState.CANCELLED, "cancelled", now)
+            elif self._expired(h, now):
+                self._finalize(h, RequestState.EXPIRED, "deadline", now)
+            else:
+                kept.append(h)
+        self.queue = kept
+
+    def _sweep_running(self, now: float) -> None:
+        for slot, h in enumerate(self._slot_req):
+            if h is None:
+                continue
+            if h._cancel:
+                self._finalize(h, RequestState.CANCELLED, "cancelled", now)
+                self._release(slot)
+            elif self._expired(h, now):
+                self._finalize(h, RequestState.EXPIRED, "deadline", now)
+                self._release(slot)
+
+    # -------------------------------------------------------------- admission
+    def _admit(self) -> bool:
+        admitted = False
+        cfg = self.config
+        while self.queue and self.executor.pool.free_slots > 0:
+            handle = self.queue.popleft()
+            slot = self.executor.pool.acquire()
+
+            def attempt(h=handle, s=slot):
+                fault_point("serving.prefill")
+                return self.executor.prefill_into_slot(s, h.prompt, h.seed)
+
+            try:
+                tok0, _ = retry_with_backoff(attempt,
+                                             retries=cfg.transient_retries,
+                                             base_delay=cfg.retry_base_delay)
+            except Exception as e:
+                # retry budget exhausted: fail THIS request, keep serving — the
+                # slot must not leak and the loop must not die with the queue
+                # still holding live requests
+                logger.error(f"[serving] prefill failed for request "
+                             f"{handle.id}: {type(e).__name__}: {e}")
+                self._finalize(handle, RequestState.CANCELLED, "error",
+                               time.monotonic())
+                self._release(slot)
+                continue
+            now = time.monotonic()
+            handle.state = RequestState.RUNNING
+            handle.slot = slot
+            handle.tokens.append(int(tok0))
+            handle.first_token_at = now
+            handle.ttft = now - handle.arrival
+            eos = -1 if handle.eos_token_id is None else int(handle.eos_token_id)
+            if tok0 == eos or handle.max_new_tokens == 1:
+                self._finalize(handle, RequestState.FINISHED,
+                               "eos" if tok0 == eos else "length", now)
+                self._release(slot)
+            else:
+                self._slot_req[slot] = handle
+                self._toks[slot] = tok0
+                self._lens[slot] = handle.prompt.size
+                self._active[slot] = True
+                self._remaining[slot] = handle.max_new_tokens - 1
+                self._eos[slot] = eos
+                self._seeds[slot] = handle.seed
+                self._steps[slot] = 1       # token 0 came from prefill
+            admitted = True
+        return admitted
+
+    # ----------------------------------------------------------------- decode
+    def _decode_chunk(self) -> bool:
+        if not self._active.any():
+            return False
+        cfg = self.config
+        steps_before = self._steps.copy()
+
+        def attempt():
+            fault_point("serving.decode_chunk")
+            return self.executor.run_chunk(
+                self._toks, self._lens, self._active, self._remaining,
+                self._eos, self._seeds, self._steps)
+
+        try:
+            res = retry_with_backoff(attempt, retries=cfg.transient_retries,
+                                     base_delay=cfg.retry_base_delay)
+        except Exception as e:
+            # retry budget exhausted mid-decode: the pool buffers may have been
+            # donated into a dispatch that died, so they cannot be trusted —
+            # fail every in-flight request, rebuild the pool, keep serving the
+            # queue (same never-kill-the-loop contract as admission)
+            logger.error(f"[serving] decode chunk failed: "
+                         f"{type(e).__name__}: {e}; failing "
+                         f"{sum(h is not None for h in self._slot_req)} "
+                         "in-flight request(s) and rebuilding the KV pool")
+            now = time.monotonic()
+            for slot, h in enumerate(self._slot_req):
+                if h is not None:
+                    self._finalize(h, RequestState.CANCELLED, "error", now)
+                    self._slot_req[slot] = None
+            self._active[:] = False
+            self._remaining[:] = 0
+            self._steps[:] = 0
+            self._eos[:] = -1
+            self.executor.reset_pool()
+            return False
+        now = time.monotonic()
+        counts = res.steps - steps_before
+        total = 0
+        for slot, h in enumerate(self._slot_req):
+            if h is None or counts[slot] <= 0:
+                continue
+            h.tokens.extend(res.buf[slot, :counts[slot]].tolist())
+            total += int(counts[slot])
+        was_active = self._active.copy()
+        self._toks = res.toks[:, 0].copy()
+        self._lens = res.lens.copy()
+        self._remaining = res.remaining.copy()
+        self._steps = res.steps.copy()
+        self._active = res.active.copy()
+        for slot in np.nonzero(was_active & ~res.active)[0]:
+            h = self._slot_req[int(slot)]
+            if h is None:
+                continue
+            reason = ("eos" if h.eos_token_id is not None
+                      and h.tokens and h.tokens[-1] == h.eos_token_id
+                      else "length")
+            self._finalize(h, RequestState.FINISHED, reason, now)
+            self._release(int(slot))
+        self.telemetry.on_chunk(total, res.elapsed)
+        return True
+
+    # --------------------------------------------------------------- lifecycle
+    def _finalize(self, handle: RequestHandle, state: RequestState,
+                  reason: str, now: float) -> None:
+        handle.state = state
+        handle.finish_reason = reason
+        handle.finished_at = now
+        if (handle.first_token_at is not None and len(handle.tokens) > 1
+                and now > handle.first_token_at):
+            handle.tpot = (now - handle.first_token_at) / (len(handle.tokens) - 1)
+        self.telemetry.on_finished(handle)
+
+    def _release(self, slot: int) -> None:
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._remaining[slot] = 0
+        self._steps[slot] = 0
+        self._eos[slot] = -1
+        self.executor.pool.release(slot)

@@ -1,0 +1,181 @@
+"""``deepspeed-serve``: the serving-subsystem entrypoint.
+
+Two modes over the same scheduler:
+
+- **stdin mode** (default): read one JSON request per line
+  (``{"prompt": [ids...], "max_new_tokens": 16, "eos_token_id": null,
+  "deadline_s": null, "seed": 0}``), stream one JSON result per completed
+  request to stdout (tokens + TTFT/TPOT + finish reason), then a final summary
+  line. Backpressured submissions are retried after the scheduler's hint.
+- **--selftest**: synthesize a small random-weight model and a burst of random
+  requests; exit 0 iff every request completes. The zero-infrastructure way to
+  prove the serving ring works on this host.
+
+Metrics go to the jsonl monitor backend when ``--jsonl-metrics DIR`` is given.
+"""
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _build_engine(args):
+    import jax.numpy as jnp
+
+    from ...models.causal_lm import gpt2_cfg, llama_cfg
+    from ..config import DeepSpeedInferenceConfig
+    from ..engine import InferenceEngine
+    family = {"gpt2": gpt2_cfg, "llama": llama_cfg}[args.family]
+    cfg = family(vocab_size=args.vocab_size, max_seq_len=args.max_seq_len,
+                 n_embd=args.n_embd, n_layer=args.n_layer, n_head=args.n_head,
+                 dtype={"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+                 [args.dtype])
+    engine = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+        dtype=args.dtype, max_out_tokens=args.max_seq_len,
+        tensor_parallel={"tp_size": args.tp}))
+    if args.checkpoint:
+        engine.load_checkpoint(args.checkpoint)
+    return engine
+
+
+def _make_monitor(args) -> Optional[object]:
+    if not args.jsonl_metrics:
+        return None
+    from ...config.config import MonitorConfig
+    from ...monitor import MonitorMaster
+    return MonitorMaster(MonitorConfig(jsonl_monitor={
+        "enabled": True, "output_path": args.jsonl_metrics,
+        "job_name": "deepspeed-serve"}))
+
+
+def _result_line(h) -> str:
+    return json.dumps({
+        "id": h.id, "state": h.state.value, "finish_reason": h.finish_reason,
+        "tokens": [int(t) for t in h.tokens],
+        "ttft_ms": None if h.ttft is None else h.ttft * 1e3,
+        "tpot_ms": None if h.tpot is None else h.tpot * 1e3,
+    })
+
+
+def _serve_stdin(sched, out=sys.stdout, inp=None):
+    """Streaming serve loop: requests are admitted as their lines arrive (a
+    reader thread feeds a queue, so a client may keep the pipe open and read
+    results before sending more) and each result is emitted the moment its
+    request completes. A malformed or inadmissible line fails alone — an
+    ``{"error": ...}`` line is emitted and serving continues."""
+    import queue as _queue
+    import threading
+
+    from .scheduler import QueueFullError
+    inp = inp if inp is not None else sys.stdin
+    lines: "_queue.Queue" = _queue.Queue()
+    _EOF = object()
+
+    def _reader():
+        for line in inp:
+            lines.put(line)
+        lines.put(_EOF)
+
+    threading.Thread(target=_reader, daemon=True).start()
+    handles, pending, eof = [], [], False
+    not_before = 0.0
+    while not eof or pending or sched.busy:
+        while True:                          # drain whatever the reader has
+            try:
+                line = lines.get_nowait()
+            except _queue.Empty:
+                break
+            if line is _EOF:
+                eof = True
+                break
+            if line.strip():
+                pending.append(line.strip())
+        while pending and time.monotonic() >= not_before:
+            try:
+                req = json.loads(pending[0])
+                handles.append(sched.submit(
+                    np.asarray(req["prompt"], np.int32),
+                    max_new_tokens=req.get("max_new_tokens"),
+                    eos_token_id=req.get("eos_token_id"),
+                    deadline_s=req.get("deadline_s"),
+                    seed=req.get("seed", 0)))
+                pending.pop(0)
+            except QueueFullError as e:      # backpressure: drain, then resubmit
+                not_before = time.monotonic() + e.retry_after
+                break
+            except Exception as e:           # bad line: fail it, keep serving
+                out.write(json.dumps({"error": f"{type(e).__name__}: {e}",
+                                      "line": pending.pop(0)[:200]}) + "\n")
+        if sched.busy:
+            sched.step()
+        elif not eof or pending:
+            time.sleep(0.01)                 # idle: await input, don't spin
+        for h in [h for h in handles if h.done]:
+            out.write(_result_line(h) + "\n")
+            handles.remove(h)
+    return sched.telemetry.snapshot()
+
+
+def _selftest(sched, n_requests: int, vocab: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    handles = []
+    from .scheduler import QueueFullError
+    reqs = [(rng.integers(0, vocab, size=int(rng.integers(3, 12))).astype(np.int32),
+             int(rng.integers(2, 10))) for _ in range(n_requests)]
+    while reqs or sched.busy:
+        while reqs:
+            prompt, max_new = reqs[0]
+            try:
+                handles.append(sched.submit(prompt, max_new_tokens=max_new))
+                reqs.pop(0)
+            except QueueFullError:
+                break
+        sched.step()
+    ok = all(h.state.value == "finished" for h in handles)
+    return ok, sched.telemetry.snapshot()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="deepspeed-serve", description=__doc__)
+    ap.add_argument("--family", default="gpt2", choices=("gpt2", "llama"))
+    ap.add_argument("--vocab-size", type=int, default=256)
+    ap.add_argument("--max-seq-len", type=int, default=128)
+    ap.add_argument("--n-embd", type=int, default=64)
+    ap.add_argument("--n-layer", type=int, default=2)
+    ap.add_argument("--n-head", type=int, default=4)
+    ap.add_argument("--dtype", default="float32", choices=("float32", "bfloat16"))
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--checkpoint", default=None,
+                    help="training checkpoint dir to serve")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--chunk-size", type=int, default=8)
+    ap.add_argument("--max-queue", type=int, default=32)
+    ap.add_argument("--jsonl-metrics", default=None,
+                    help="directory for the jsonl monitor backend")
+    ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--requests", type=int, default=8,
+                    help="selftest request count")
+    args = ap.parse_args(argv)
+
+    from .scheduler import ContinuousBatchingScheduler, ServingConfig
+    engine = _build_engine(args)
+    sched = ContinuousBatchingScheduler(
+        engine, ServingConfig(slots=args.slots, chunk_size=args.chunk_size,
+                              max_queue=args.max_queue,
+                              max_seq_len=args.max_seq_len),
+        monitor=_make_monitor(args))
+    if args.selftest:
+        ok, snap = _selftest(sched, args.requests, args.vocab_size)
+        print(json.dumps({"selftest_ok": ok, **snap}))
+        return 0 if ok else 1
+    snap = _serve_stdin(sched)
+    print(json.dumps(snap), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

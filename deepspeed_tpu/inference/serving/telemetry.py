@@ -1,0 +1,101 @@
+"""Serving telemetry: per-request TTFT/TPOT, queue depth, slot occupancy,
+tokens/sec — emitted as ``MonitorMaster`` events (any enabled backend: csv,
+tensorboard, wandb, jsonl) and aggregated for the load-generator's BENCH JSON.
+
+Event tags (step semantics in parentheses):
+
+- ``serving/ttft_ms``, ``serving/tpot_ms`` — per finished request (completion idx);
+- ``serving/tokens_per_sec`` — per decode chunk (chunk idx);
+- ``serving/queue_depth``, ``serving/slot_occupancy`` — per scheduler step (tick);
+- ``serving/completed_total``, ``serving/rejected_total`` — per scheduler step.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ServingTelemetry:
+    """Aggregator + event emitter; ``monitor`` is an optional MonitorMaster."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self._tick = 0
+        self._chunk_idx = 0
+        self._finished_idx = 0
+        self.ttfts: List[float] = []
+        self.tpots: List[float] = []
+        self.tokens_total = 0
+        self.completed = 0
+        self.rejected = 0
+        self.cancelled = 0
+        self.expired = 0
+        self.decode_seconds = 0.0
+        self._t_start = time.perf_counter()
+
+    # ------------------------------------------------------------------- emits
+    def _write(self, events):
+        if self.monitor is not None and getattr(self.monitor, "enabled", False):
+            self.monitor.write_events(events)
+
+    def on_step(self, queue_depth: int, occupancy: float) -> None:
+        self._tick += 1
+        self._write([("serving/queue_depth", float(queue_depth), self._tick),
+                     ("serving/slot_occupancy", float(occupancy), self._tick),
+                     ("serving/completed_total", float(self.completed), self._tick),
+                     ("serving/rejected_total", float(self.rejected), self._tick)])
+
+    def on_chunk(self, tokens: int, elapsed: float) -> None:
+        self._chunk_idx += 1
+        self.tokens_total += int(tokens)
+        self.decode_seconds += float(elapsed)
+        if elapsed > 0:
+            self._write([("serving/tokens_per_sec", tokens / elapsed,
+                          self._chunk_idx)])
+
+    def on_rejected(self) -> None:
+        self.rejected += 1
+
+    def on_finished(self, handle) -> None:
+        from .scheduler import RequestState
+        if handle.state == RequestState.CANCELLED:
+            self.cancelled += 1
+            return
+        if handle.state == RequestState.EXPIRED:
+            self.expired += 1
+            return
+        self.completed += 1
+        self._finished_idx += 1
+        events = []
+        if handle.ttft is not None:
+            self.ttfts.append(handle.ttft)
+            events.append(("serving/ttft_ms", handle.ttft * 1e3,
+                           self._finished_idx))
+        if handle.tpot is not None:
+            self.tpots.append(handle.tpot)
+            events.append(("serving/tpot_ms", handle.tpot * 1e3,
+                           self._finished_idx))
+        self._write(events)
+
+    # --------------------------------------------------------------- aggregate
+    @staticmethod
+    def _pct(xs: List[float], q: float) -> Optional[float]:
+        return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+    def snapshot(self) -> Dict:
+        elapsed = time.perf_counter() - self._t_start
+        return {
+            "elapsed_s": elapsed,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "cancelled": self.cancelled,
+            "expired": self.expired,
+            "tokens_total": self.tokens_total,
+            "tokens_per_sec": (self.tokens_total / self.decode_seconds
+                               if self.decode_seconds > 0 else 0.0),
+            "ttft_ms_p50": self._pct([x * 1e3 for x in self.ttfts], 50),
+            "ttft_ms_p95": self._pct([x * 1e3 for x in self.ttfts], 95),
+            "tpot_ms_p50": self._pct([x * 1e3 for x in self.tpots], 50),
+            "tpot_ms_p95": self._pct([x * 1e3 for x in self.tpots], 95),
+        }

@@ -1,1 +1,2 @@
-from .monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor
+from .monitor import (MonitorMaster, TensorBoardMonitor, WandbMonitor,
+                      csvMonitor, jsonlMonitor)

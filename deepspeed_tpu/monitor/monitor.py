@@ -114,6 +114,38 @@ class csvMonitor(Monitor):
         self._files = {}
 
 
+class jsonlMonitor(Monitor):
+    """Scrape-free metrics: one JSON object per event, appended to a single
+    ``<job_name>.jsonl`` file — the serving-run backend (tail the file, no
+    TensorBoard/W&B infrastructure). Naming follows ``csvMonitor``."""
+
+    def __init__(self, config):
+        self.enabled = bool(config.enabled) and _rank0()
+        self._file = None
+        if not self.enabled:
+            return
+        out_dir = config.output_path or "./jsonl_monitor"
+        os.makedirs(out_dir, exist_ok=True)
+        self.path = os.path.join(out_dir, config.job_name + ".jsonl")
+        self._file = open(self.path, "a", buffering=1)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        import json
+        import time
+        ts = time.time()
+        for tag, value, step in event_list:
+            self._file.write(json.dumps({"tag": tag, "value": float(value),
+                                         "step": int(step), "ts": ts}) + "\n")
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        self.enabled = False
+
+
 class MonitorMaster(Monitor):
     """Dispatches events to every enabled backend, rank 0 only
     (reference ``monitor/monitor.py:48``)."""
@@ -123,19 +155,26 @@ class MonitorMaster(Monitor):
         self.tb_monitor: Optional[TensorBoardMonitor] = None
         self.wandb_monitor: Optional[WandbMonitor] = None
         self.csv_monitor: Optional[csvMonitor] = None
+        self.jsonl_monitor: Optional[jsonlMonitor] = None
         if monitor_config.tensorboard.enabled:
             self.tb_monitor = TensorBoardMonitor(monitor_config.tensorboard)
         if monitor_config.wandb.enabled:
             self.wandb_monitor = WandbMonitor(monitor_config.wandb)
         if monitor_config.csv_monitor.enabled:
             self.csv_monitor = csvMonitor(monitor_config.csv_monitor)
-        self.enabled = any(m is not None and m.enabled for m in
-                           (self.tb_monitor, self.wandb_monitor, self.csv_monitor))
+        if getattr(monitor_config, "jsonl_monitor", None) is not None and \
+                monitor_config.jsonl_monitor.enabled:
+            self.jsonl_monitor = jsonlMonitor(monitor_config.jsonl_monitor)
+        self.enabled = any(m is not None and m.enabled for m in self._backends())
+
+    def _backends(self):
+        return (self.tb_monitor, self.wandb_monitor, self.csv_monitor,
+                self.jsonl_monitor)
 
     def write_events(self, event_list: List[Event]) -> None:
         if not self.enabled or not event_list:
             return
         events = [(tag, float(value), int(step)) for tag, value, step in event_list]
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for m in self._backends():
             if m is not None and m.enabled:
                 m.write_events(events)

@@ -28,17 +28,27 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running convergence/perf lanes "
         "(deselect with -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "serving: continuous-batching serving lane (scheduler, "
+        "KV slot pool, chunked decode, loadgen smoke) — tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
-    """The fault-tolerance lane (crash-consistent checkpoints, kill/restart
-    recovery) must land inside tier-1's wall-clock budget — the full suite can
-    overrun it on CPU, and 'tests/unit/runtime' sorts late alphabetically. Run
-    that file first; relative order of everything else is unchanged."""
-    front = [it for it in items if "test_fault_tolerance" in it.nodeid]
-    if front:
-        rest = [it for it in items if "test_fault_tolerance" not in it.nodeid]
-        items[:] = front + rest
+    """The fault-tolerance and serving lanes must land inside tier-1's
+    wall-clock budget — the full suite can overrun it on CPU, and both sort
+    late alphabetically ('tests/unit/runtime', 'tests/unit/inference/serving').
+    Run fault tolerance first, serving second; relative order of everything
+    else is unchanged."""
+
+    def rank(it):
+        if "test_fault_tolerance" in it.nodeid:
+            return 0
+        if "inference/serving" in it.nodeid:
+            return 1
+        return 2
+
+    if any(rank(it) < 2 for it in items):
+        items.sort(key=rank)        # stable: preserves order within each rank
 
 
 @pytest.fixture(autouse=True)

@@ -140,6 +140,83 @@ def test_eos_early_stop_on_device():
     assert int(out[0, 6]) == first
 
 
+def test_post_eos_rows_emit_eos_not_stale():
+    """After a row hits EOS, every subsequent token it emits must be EOS — never
+    stale decode-buffer contents — while unfinished rows decode on unaffected."""
+    cfg = gpt2_cfg(**TINY)
+    engine = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    for seed in range(8):
+        rng = np.random.default_rng(100 + seed)
+        ids = rng.integers(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+        free = engine.generate(ids, max_new_tokens=6)
+        eos = int(free[0, 8])                  # row 0's first generated token
+        if eos not in free[1, 8:].tolist():    # row 1 must stay alive
+            break
+    else:
+        pytest.skip("tiny random model: no prompt pair with distinct streams")
+    out = engine.generate(ids, max_new_tokens=6, eos_token_id=eos)
+    assert out.shape[1] == 8 + 6               # row 1 kept the loop running
+    assert int(out[0, 8]) == eos
+    assert (out[0, 9:] == eos).all()           # post-EOS content is EOS only
+    np.testing.assert_array_equal(out[1], free[1])   # row 1 unaffected
+
+
+def test_unequal_prompt_finished_row_emits_eos_pad():
+    """Unequal right-padded prompts where the SHORT row finishes first: its
+    generated tokens overwrite cache pad slots, and once finished it must emit
+    EOS — never stale buffer contents — while the long row decodes on."""
+    cfg = gpt2_cfg(**TINY)
+    engine = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    for seed in range(8):
+        rng = np.random.default_rng(200 + seed)
+        ids = np.zeros((2, 8), dtype=np.int32)
+        ids[0] = rng.integers(0, cfg.vocab_size, size=8)
+        ids[1, :5] = rng.integers(0, cfg.vocab_size, size=5)
+        mask = np.zeros((2, 8), dtype=np.int32)
+        mask[0] = 1
+        mask[1, :5] = 1
+        free = engine.generate(ids, max_new_tokens=6, attention_mask=mask)
+        eos = int(free[1, 8])                  # short row's first generated token
+        if eos not in free[0, 8:].tolist():
+            break
+    else:
+        pytest.skip("tiny random model: no prompt pair with distinct streams")
+    out = engine.generate(ids, max_new_tokens=6, attention_mask=mask,
+                          eos_token_id=eos)
+    assert out.shape[1] == 8 + 6
+    assert int(out[1, 8]) == eos
+    assert (out[1, 9:] == eos).all()           # finished row: EOS/pad only
+    np.testing.assert_array_equal(out[0], free[0])   # long row unaffected
+
+
+def test_generate_records_tpot_and_monitor_events(tmp_path):
+    """generate records TPOT/decode tokens-per-second alongside ttft and, with a
+    monitor attached, emits all three as events."""
+    import json as _json
+
+    from deepspeed_tpu.config.config import MonitorConfig
+    from deepspeed_tpu.monitor import MonitorMaster
+    cfg = gpt2_cfg(**TINY)
+    engine = InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    master = MonitorMaster(MonitorConfig(jsonl_monitor={
+        "enabled": True, "output_path": str(tmp_path), "job_name": "gen"}))
+    engine.set_monitor(master)
+    rng = np.random.default_rng(13)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 6)).astype(np.int32)
+    engine.generate(ids, max_new_tokens=5)
+    assert engine.ttft is not None and engine.ttft > 0
+    assert engine.tpot is not None and engine.tpot > 0
+    assert engine.decode_tps is not None and engine.decode_tps > 0
+    import os as _os
+    path = _os.path.join(str(tmp_path), "gen.jsonl")
+    tags = {_json.loads(line)["tag"] for line in open(path)}
+    assert {"inference/ttft_ms", "inference/tpot_ms",
+            "inference/decode_tokens_per_sec"} <= tags
+
+
 def test_int8_generate_close_to_fp():
     """dtype="int8": weights grouped-quantized at load (reference GroupQuantizer /
     dequantize.cu), generation stays close to the fp path."""

@@ -36,6 +36,43 @@ def test_monitor_master_dispatch(tmp_path):
         assert "7,3.0" in f.read()
 
 
+def test_jsonl_monitor_writes_events(tmp_path):
+    from deepspeed_tpu.monitor import jsonlMonitor
+    cfg = MonitorConfig(jsonl_monitor={"enabled": True,
+                                       "output_path": str(tmp_path),
+                                       "job_name": "job"})
+    mon = jsonlMonitor(cfg.jsonl_monitor)
+    assert mon.enabled
+    mon.write_events([("Serve/ttft", 12.5, 1), ("Serve/ttft", 11.0, 2)])
+    mon.close()
+    import json
+    lines = [json.loads(x) for x in open(os.path.join(str(tmp_path),
+                                                      "job.jsonl"))]
+    assert lines[0] == {"tag": "Serve/ttft", "value": 12.5, "step": 1,
+                        "ts": lines[0]["ts"]}
+    assert lines[1]["value"] == 11.0 and lines[1]["step"] == 2
+    assert all("ts" in ln for ln in lines)
+
+
+def test_jsonl_monitor_master_dispatch_and_config(tmp_path):
+    """jsonl backend selected via the monitor config block (the serving-run,
+    scrape-free path) and dispatched by MonitorMaster."""
+    from deepspeed_tpu.config.config import DeepSpeedConfig
+    dsc = DeepSpeedConfig({"train_batch_size": 8,
+                           "jsonl_monitor": {"enabled": True,
+                                             "output_path": str(tmp_path),
+                                             "job_name": "m"}})
+    assert dsc.monitor_config.jsonl_monitor.enabled
+    assert dsc.monitor_config.enabled
+    master = MonitorMaster(dsc.monitor_config)
+    assert master.enabled
+    master.write_events([("a/b", 3.0, 7)])
+    import json
+    (rec,) = [json.loads(x) for x in open(os.path.join(str(tmp_path),
+                                                       "m.jsonl"))]
+    assert rec["tag"] == "a/b" and rec["value"] == 3.0 and rec["step"] == 7
+
+
 def test_disabled_monitor_noop():
     master = MonitorMaster(MonitorConfig())
     assert not master.enabled
