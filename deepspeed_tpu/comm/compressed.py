@@ -58,6 +58,102 @@ def compress_signs(x: jnp.ndarray,
     return _pack_bits(signs), scale, new_error
 
 
+# --------------------------------------------------- int8 blockwise (EQuARX)
+# The 8-bit sibling of the sign collective above (EQuARX, arxiv 2506.17615):
+# per-block absmax scales instead of one global L1 scale, int8 payload instead
+# of packed signs — ~3.9x wire reduction at near-lossless gradient fidelity,
+# with the SAME error-feedback contract as sign_compress so the two compose
+# with (rather than replace) each other: transmitted + new_error == x + error.
+
+def int8_blockwise_compress(flat: jnp.ndarray, block: int = 256
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(n,) f32 → (q int8 (n_pad,), scales f32 (n_pad/block,)); symmetric
+    absmax per block (``scale = absmax/127``, zero blocks get scale 1)."""
+    n = flat.shape[0]
+    pad = (-n) % block
+    fb = jnp.pad(flat, (0, pad)).reshape(-1, block)
+    amax = jnp.max(jnp.abs(fb), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(fb / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scale[:, 0]
+
+
+def int8_blockwise_decompress(q: jnp.ndarray, scales: jnp.ndarray, n: int,
+                              block: int = 256) -> jnp.ndarray:
+    """Inverse of :func:`int8_blockwise_compress` (drops the pad)."""
+    fb = q.reshape(-1, block).astype(jnp.float32) * scales[:, None]
+    return fb.reshape(-1)[:n]
+
+
+def quantized_allreduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str,
+                        block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Error-compensated int8 blockwise mean over ``axis_name`` (call inside
+    ``shard_map``); returns ``(replicated quantized mean, new local error)``.
+
+    Two-phase, EQuARX-shaped, so per-worker wire volume stays O(n) at any
+    world size (a naive gather-then-sum moves ``(W-1)·n`` — MORE than fp32
+    beyond W≈8):
+
+    1. **reduce-scatter phase**: each worker quantizes its contribution and
+       ``all_to_all``s int8 chunk ``p`` (+ its scales) to worker ``p``, which
+       dequantizes and sums its owned chunk in fixed rank order
+       (deterministic);
+    2. **gather phase**: the owned mean chunk is RE-quantized to int8 and
+       ``all_gather``ed, so the wire stays 8-bit both ways.
+
+    Both quantization stages are error-fed-back: phase 1 into this worker's
+    residual everywhere, phase 2 (whose error is shared by construction) into
+    the OWNED chunk's residual scaled by ``W`` — the next round's mean dilutes
+    it back by ``1/W``, preserving the cumulative-transmission EF contract
+    shared with :func:`compress_signs`.
+
+    Non-finite inputs (fp16 overflow) are zeroed BEFORE quantization so a
+    single inf cannot poison the int8 cast or the residual — the caller
+    detects overflow from the pre-quantization values and skips the step.
+
+    Collective volume per worker per phase: ``(W-1)/W · (n + 4n/block)``
+    bytes (int8 payload + fp32 block scales) — ~3.9x under full-precision
+    ring allreduce (``8n·(W-1)/W``) at block=256.
+    """
+    shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    err = error.reshape(-1).astype(jnp.float32)
+    c = flat + err
+    c = jnp.where(jnp.isfinite(c), c, 0.0)
+    n = flat.shape[0]
+    W = jax.lax.psum(1, axis_name)
+    if W == 1:
+        q, scales = int8_blockwise_compress(c, block)
+        deq = int8_blockwise_decompress(q, scales, n, block)
+        return deq.reshape(shape), (c - deq).reshape(shape)
+
+    # pad so payload AND scale vectors split evenly across the W ranks
+    n_pad = -((-n) // (block * W)) * (block * W)
+    cp = jnp.pad(c, (0, n_pad - n))
+    q, scales = int8_blockwise_compress(cp, block)  # (n_pad,), (n_pad/block,)
+    chunk = n_pad // W
+    bpc = (n_pad // block) // W                     # scale blocks per chunk
+    # phase 1: rank p ends holding every rank's chunk p (int8 on the wire)
+    qx = jax.lax.all_to_all(q.reshape(W, chunk), axis_name, 0, 0, tiled=True)
+    sx = jax.lax.all_to_all(scales.reshape(W, bpc), axis_name, 0, 0,
+                            tiled=True)
+    part = qx.reshape(W, bpc, block).astype(jnp.float32) * sx[:, :, None]
+    mean_chunk = jnp.sum(part, axis=0).reshape(chunk) / W
+    # phase 2: re-quantize the owned mean chunk, gather int8 + scales
+    q2, s2 = int8_blockwise_compress(mean_chunk, block)
+    deq_chunk = int8_blockwise_decompress(q2, s2, chunk, block)
+    qg = jax.lax.all_gather(q2, axis_name, axis=0, tiled=True)   # (n_pad,)
+    sg = jax.lax.all_gather(s2, axis_name, axis=0, tiled=True)
+    mean = int8_blockwise_decompress(qg, sg, n, block)
+    # error feedback: phase-1 everywhere, phase-2 at the owned chunk ×W
+    r = cp - int8_blockwise_decompress(q, scales, n_pad, block)
+    idx = jax.lax.axis_index(axis_name)
+    r = jax.lax.dynamic_update_slice(
+        r, jax.lax.dynamic_slice(r, (idx * chunk,), (chunk,))
+        + W * (mean_chunk - deq_chunk), (idx * chunk,))
+    return mean.reshape(shape), r[:n].reshape(shape)
+
+
 def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray,
                          axis_name: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """1-bit mean over ``axis_name`` (call inside ``shard_map``); returns

@@ -199,6 +199,14 @@ class DeepSpeedConfig:
         self.activation_checkpointing = ActivationCheckpointingConfig(
             **pd.get(C.ACTIVATION_CHECKPOINTING, {}))
         self.comms_logger = CommsLoggerConfig(**pd.get(C.COMMS_LOGGER, {}))
+        # "comm_overlap" (T3 arxiv 2401.16677 + EQuARX arxiv 2506.17615) stays
+        # a raw dict here — parallel.overlap.OverlapConfig is the single
+        # source of truth for keys/defaults, and resolve_overlap_config (which
+        # rejects unknown keys) validates it; called now so bad keys still
+        # fail at config parse, not first trace.
+        from ..parallel.overlap import resolve_overlap_config
+        self.comm_overlap = dict(pd.get(C.COMM_OVERLAP, {}))
+        resolve_overlap_config(self.comm_overlap)
         self.monitor_config = MonitorConfig(
             tensorboard=pd.get(C.MONITOR_TENSORBOARD, {}),
             wandb=pd.get(C.MONITOR_WANDB, {}),

@@ -62,6 +62,8 @@ ZERO_OPTIMIZATION = "zero_optimization"
 # Parallelism (TPU-native addition: mesh axes in config)
 #############################################
 MESH = "mesh"  # {"data": -1, "fsdp": 1, "tensor": 1, "pipe": 1, "expert": 1, "seq": 1}
+# comm-compute overlap: chunked collective matmuls + quantized collectives
+COMM_OVERLAP = "comm_overlap"
 
 #############################################
 # Subsystems

@@ -47,25 +47,31 @@ def main() -> int:
     print(f"  {'g++':<18} {_gxx_version()}")
 
     print("devices:")
-    try:
-        import jax
-        devs = jax.devices()
-        print(f"  platform={devs[0].platform} device_count={len(devs)} "
-              f"process={jax.process_index()}/{jax.process_count()}")
-        for d in devs[:8]:
-            stats = {}
-            try:
-                stats = d.memory_stats() or {}
-            except Exception:
-                pass
-            lim = stats.get("bytes_limit")
-            mem = f" hbm={lim / 1024**3:.1f}GB" if lim else ""
-            print(f"  {d.id}: {d.device_kind}{mem}")
-        if len(devs) > 8:
-            print(f"  ... and {len(devs) - 8} more")
-    except Exception as e:
-        print(f"  jax backend unavailable: {e}")
+    # a dead TPU tunnel makes jax.devices() BLOCK (not raise) — the probe's
+    # throwaway child does the ONE backend init and hands back the whole
+    # inventory (in-process short-circuit when env pins CPU or the backend is
+    # already live here)
+    from .utils.device_probe import probe_device_inventory
+    inv = probe_device_inventory()
+    if inv is None:
+        print("  jax backend unavailable (device probe timed out or "
+              "failed — tunnel down?)")
+        _print_ops_table()
+        return 0
+    print(f"  platform={inv['platform']} device_count={inv['device_count']} "
+          f"process={inv['process_index']}/{inv['process_count']}")
+    for d in inv["devices"]:
+        lim = d.get("bytes_limit")
+        mem = f" hbm={lim / 1024**3:.1f}GB" if lim else ""
+        print(f"  {d['id']}: {d['kind']}{mem}")
+    if inv["device_count"] > len(inv["devices"]):
+        print(f"  ... and {inv['device_count'] - len(inv['devices'])} more")
 
+    _print_ops_table()
+    return 0
+
+
+def _print_ops_table():
     print("host-side native ops (op_builder):")
     from .ops.adam.cpu_adam import native_available
     print(f"  cpu_adam/cpu_adagrad (SIMD offload step) "
@@ -76,7 +82,6 @@ def main() -> int:
     except ImportError:
         print(f"  async_io (NVMe swap) {RED_NO}")
     print("-" * 70)
-    return 0
 
 
 if __name__ == "__main__":

@@ -48,6 +48,11 @@ class DeepSpeedInferenceConfig(ConfigModel):
     # engine.model_config after the engine (and its compiled fns) exist
     moe_decode_impl: Optional[str] = None
 
+    # comm-compute overlap block (chunked collective matmuls on the TP decode
+    # hot path; same keys as the training config's "comm_overlap" — parsed by
+    # parallel.overlap.resolve_overlap_config at engine construction)
+    comm_overlap: Dict[str, Any] = Field(default_factory=dict)
+
     # convenience aliases the reference accepts at top level
     mp_size: Optional[int] = None                 # deprecated alias of tensor_parallel.tp_size
 

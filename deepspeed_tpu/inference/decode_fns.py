@@ -22,6 +22,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from ..parallel.overlap import overlap_scope
+
 
 def logits_transform(do_sample: bool, temperature: float, top_k: int,
                      top_p: float) -> Callable[[Any], Any]:
@@ -81,27 +83,33 @@ def make_slot_select_fn(do_sample: bool, temperature: float, top_k: int,
     return select
 
 
-def build_prefill(module, dequant):
+def build_prefill(module, dequant, overlap=None):
     """Prefill: one forward over the (right-padded) prompt, logits read only at each
     sequence's last valid position (``logits_positions`` skips the rest of the head
-    matmul), KV written into the fixed cache buffers."""
+    matmul), KV written into the fixed cache buffers.
+
+    ``overlap``: the owning engine's ``OverlapConfig`` — installed for the
+    duration of the TRACE (``overlap_scope``) so the compiled body bakes in
+    that engine's comm-overlap lowering regardless of ambient global state.
+    """
 
     def prefill(params, ids, caches, lens0):
-        logits, new_caches = module.apply(
-            {"params": dequant(params)}, ids, caches=caches,
-            cache_lens=jnp.zeros_like(lens0),
-            logits_positions=jnp.maximum(lens0 - 1, 0))
+        with overlap_scope(overlap):
+            logits, new_caches = module.apply(
+                {"params": dequant(params)}, ids, caches=caches,
+                cache_lens=jnp.zeros_like(lens0),
+                logits_positions=jnp.maximum(lens0 - 1, 0))
         return logits[:, 0], new_caches
 
     return prefill
 
 
-def build_decode_loop(module, dequant, select, gen_cap: int):
+def build_decode_loop(module, dequant, select, gen_cap: int, overlap=None):
     """Whole-batch run-to-completion decode: ONE ``lax.while_loop`` for all remaining
     tokens, EOS termination as an on-device reduction in the loop condition
     (``InferenceEngine.generate``'s decode shape)."""
 
-    def decode_loop(params, tok0, caches, lens, n_new, eos, rng):
+    def decode_loop_inner(params, tok0, caches, lens, n_new, eos, rng):
         b = tok0.shape[0]
         buf = jnp.zeros((b, gen_cap), jnp.int32).at[:, 0].set(tok0[:, 0])
         finished0 = tok0[:, 0] == eos          # eos = -1 when unused: never matches
@@ -129,10 +137,17 @@ def build_decode_loop(module, dequant, select, gen_cap: int):
         n, _, _, _, _, buf = jax.lax.while_loop(cond, body, state)
         return buf, n
 
+    def decode_loop(*args):
+        # overlap_scope is a trace-time effect: the while_loop body traces
+        # inside it, baking the owning engine's comm-overlap lowering in
+        with overlap_scope(overlap):
+            return decode_loop_inner(*args)
+
     return decode_loop
 
 
-def build_decode_chunk(module, dequant, slot_select, chunk_size: int):
+def build_decode_chunk(module, dequant, slot_select, chunk_size: int,
+                       overlap=None):
     """Fixed-shape chunked decode over a slot-batch: exactly ``chunk_size`` steps,
     every shape static, one compile per (slots, cap, chunk, sampling) key.
 
@@ -174,9 +189,10 @@ def build_decode_chunk(module, dequant, slot_select, chunk_size: int):
             active = jnp.logical_and(active, jnp.logical_not(finished))
             return tok, caches, lens, active, remaining, steps, buf
 
-        toks, caches, lens, active, remaining, steps, buf = jax.lax.fori_loop(
-            0, chunk_size, body,
-            (toks, caches, lens, active, remaining, steps, buf))
+        with overlap_scope(overlap):     # trace-time: fori body traces inside
+            toks, caches, lens, active, remaining, steps, buf = jax.lax.fori_loop(
+                0, chunk_size, body,
+                (toks, caches, lens, active, remaining, steps, buf))
         return buf, toks, caches, lens, active, remaining, steps
 
     return decode_chunk

@@ -75,7 +75,8 @@ class DiffusionInferenceEngine:
                  clip_config: CLIPTextConfig, clip_params: Any,
                  vae_config: VAEConfig, vae_params: Any,
                  num_train_timesteps: int = 1000,
-                 mesh_spec: Optional[MeshSpec] = None):
+                 mesh_spec: Optional[MeshSpec] = None,
+                 set_alpha_to_one: bool = False):
         self.unet_config = unet_config
         self.clip_config = clip_config
         self.vae_config = vae_config
@@ -103,6 +104,12 @@ class DiffusionInferenceEngine:
             self.params = shard_diffusion_params(self.params, mesh_spec)
         self.alphas_cumprod = ddim_schedule(num_train_timesteps)
         self.num_train_timesteps = num_train_timesteps
+        # Final-step alpha when prev_t < 0: diffusers' DDIMScheduler knob.
+        # SD-1.x ships ``set_alpha_to_one=false`` → final_alpha_cumprod =
+        # alphas_cumprod[0] (the first schedule entry), NOT 1.0 — using 1.0
+        # diverges from diffusers on the very last denoising step.
+        self.final_alpha_cumprod = (jnp.float32(1.0) if set_alpha_to_one
+                                    else self.alphas_cumprod[0])
         self._fns: Dict[Any, Any] = {}
         log_dist(
             f"diffusion engine ready: unet {unet_config.block_out_channels} "
@@ -138,7 +145,7 @@ class DiffusionInferenceEngine:
                 eps = eps_u + guidance * (eps_c - eps_u)
                 a_t = alphas[t]
                 a_prev = jnp.where(prev_t >= 0, alphas[jnp.maximum(prev_t, 0)],
-                                   jnp.float32(1.0))
+                                   self.final_alpha_cumprod)
                 x0 = (lat - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
                 return jnp.sqrt(a_prev) * x0 + jnp.sqrt(1.0 - a_prev) * eps
 
@@ -176,7 +183,8 @@ def init_diffusion_inference(unet_sd: Dict[str, Any], clip_model,
                              vae_sd: Dict[str, Any],
                              unet_config: Optional[UNetConfig] = None,
                              vae_config: Optional[VAEConfig] = None,
-                             mesh_spec: Optional[MeshSpec] = None
+                             mesh_spec: Optional[MeshSpec] = None,
+                             set_alpha_to_one: bool = False
                              ) -> DiffusionInferenceEngine:
     """``generic_injection`` surface: torch state dicts (diffusers naming) + the
     HF CLIP text model → a fully converted, compiled TPU engine."""
@@ -190,4 +198,5 @@ def init_diffusion_inference(unet_sd: Dict[str, Any], clip_model,
     clip_config, clip_params = convert_clip_text(clip_model)
     return DiffusionInferenceEngine(unet_config, unet_params, clip_config,
                                     clip_params, vae_config, vae_params,
-                                    mesh_spec=mesh_spec)
+                                    mesh_spec=mesh_spec,
+                                    set_alpha_to_one=set_alpha_to_one)

@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.causal_lm import (CausalLM, CausalLMConfig, causal_lm_param_specs,
                                 init_cache)
 from ..parallel.mesh import AXIS_DATA, AXIS_TENSOR, MeshSpec, set_global_mesh
+from ..parallel.overlap import resolve_overlap_config, set_overlap_config
 from ..utils.logging import log_dist, logger
 from .config import DeepSpeedInferenceConfig
 from .decode_fns import build_decode_loop, build_prefill, make_select_fn
@@ -58,6 +59,11 @@ class InferenceEngine:
         # activate our mesh BEFORE any model tracing — a previously-active engine's mesh
         # must not leak into this engine's init/forward traces
         set_global_mesh(self.mesh_spec)
+        # comm-compute overlap (chunked collective matmuls on the TP decode
+        # path); installed like the mesh so every trace this engine initiates
+        # sees ITS setting, and threaded into the compiled-step builders
+        self.comm_overlap = resolve_overlap_config(self._config.comm_overlap)
+        set_overlap_config(self.comm_overlap)
 
         # validate the impl override BEFORE any model resolution/tracing so a
         # bad value ('triton', 'XLA') fails fast at construction
@@ -216,7 +222,8 @@ class InferenceEngine:
         if key in self._fns:
             return self._fns[key]
         select = self._select_fn(do_sample, temperature, top_k, top_p)
-        prefill_logits = build_prefill(self.module, self._dequant)
+        prefill_logits = build_prefill(self.module, self._dequant,
+                                       overlap=self.comm_overlap)
 
         def prefill(params, ids, caches, lens0, rng):
             # ids may be right-padded: next-token logits are computed ONLY at each
@@ -226,7 +233,8 @@ class InferenceEngine:
             logits, new_caches = prefill_logits(params, ids, caches, lens0)
             return select(logits, rng), new_caches, lens0
 
-        decode_loop = build_decode_loop(self.module, self._dequant, select, gen_cap)
+        decode_loop = build_decode_loop(self.module, self._dequant, select, gen_cap,
+                                        overlap=self.comm_overlap)
 
         # No donation on either fn: prefill rebuilds cache buffers (pad-write) and the loop
         # reuses its carry buffers internally — donating caches cannot alias any output
@@ -251,6 +259,7 @@ class InferenceEngine:
         # engines may coexist (e.g. tp=1 and tp=4); tracing consults the global mesh, so
         # re-assert ours before any compiled-fn call
         set_global_mesh(self.mesh_spec)
+        set_overlap_config(self.comm_overlap)
 
     def forward(self, input_ids, *args, **kwargs):
         """Full forward logits (reference ``InferenceEngine.forward:541``)."""

@@ -90,7 +90,9 @@ class ChunkedDecodeExecutor:
         fns = self.engine._fns
         if key not in fns:
             chunk = build_decode_chunk(self.engine.module, self.engine._dequant,
-                                       self._slot_select, self.chunk_size)
+                                       self._slot_select, self.chunk_size,
+                                       overlap=getattr(self.engine,
+                                                       "comm_overlap", None))
             fns[key] = jax.jit(chunk, donate_argnums=(2,))   # caches
         return fns[key]
 
@@ -99,7 +101,9 @@ class ChunkedDecodeExecutor:
         fns = self.engine._fns
         if key not in fns:
             engine = self.engine
-            prefill_logits = build_prefill(engine.module, engine._dequant)
+            prefill_logits = build_prefill(engine.module, engine._dequant,
+                                           overlap=getattr(engine,
+                                                           "comm_overlap", None))
             select = self._slot_select
             cfg = engine.model_config
             cap, dtype = self.cap, engine.dtype

@@ -25,6 +25,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.attention.decode import decode_attention, decode_attention_xla
 from ..ops.transformer.attention import xla_attention
+from ..parallel.overlap import (RowParallelDense, chunked_expert_exchange,
+                                get_overlap_config, moe_overlap_chunks)
 from .base import Model
 from ..utils.jax_compat import shard_map
 
@@ -260,8 +262,12 @@ class CausalLMLayer(nn.Module):
             h = nn.Dense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
                          kernel_init=init, name="fc_in")(h)
             h = act(h)
-        return nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                        kernel_init=proj_init, name="fc_out")(h)
+        # row-parallel TP site: lowers to the chunked matmul-reduce-scatter
+        # ring when comm_overlap is active (plain matmul + GSPMD allreduce
+        # otherwise); parameter tree identical to nn.Dense
+        return RowParallelDense(cfg.n_embd, use_bias=cfg.mlp_bias,
+                                dtype=cfg.dtype, kernel_init=proj_init,
+                                span="tp.fc_out", name="fc_out")(h)
 
     # prefill tokens are routed in chunks of this size: the one-hot dispatch/combine
     # tensors are (C, e, C) per chunk — linear total memory/flops in token count instead
@@ -343,12 +349,17 @@ class CausalLMLayer(nn.Module):
                                xc.astype(jnp.float32)).astype(cdtype)
         expert_in = expert_in.reshape(e, n * cap, d)
         if expert_sharded:
-            expert_in = jax.lax.with_sharding_constraint(
-                expert_in, mesh.sharding(P(AXIS_EXPERT, None, None)))
-        expert_out = expert_fn(expert_in)                             # (e, n*cap, m)
-        if expert_sharded:
-            expert_out = jax.lax.with_sharding_constraint(
-                expert_out, mesh.sharding(P(AXIS_EXPERT, None, None)))
+            # capacity-chunked exchange when comm_overlap is active: each
+            # chunk's token-major → expert-major a2a overlaps the previous
+            # chunk's expert FFN (bitwise-exact — per-token FFN, whole combine)
+            n_chunks = moe_overlap_chunks(get_overlap_config(),
+                                          mesh.size(AXIS_EXPERT), n * cap)
+            expert_out = chunked_expert_exchange(
+                expert_in, expert_fn,
+                mesh.sharding(P(AXIS_EXPERT, None, None)), n_chunks,
+                site="moe.decode_a2a")
+        else:
+            expert_out = expert_fn(expert_in)                         # (e, n*cap, m)
         expert_out = expert_out.reshape(e, n, cap, d)
         out = jnp.einsum("nsec,encm->nsm", combine.astype(jnp.float32),
                          expert_out.astype(jnp.float32))
@@ -393,8 +404,9 @@ class CausalLMLayer(nn.Module):
                           "v": jnp.pad(v_hm, pad).astype(cache["v"].dtype)}
         o = o.reshape(b, t, cfg.n_embd)
         proj_init = nn.initializers.normal(cfg.init_std / (2 * cfg.n_layer) ** 0.5)
-        attn_out = nn.Dense(cfg.n_embd, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                            kernel_init=proj_init, name="o_proj")(o)
+        attn_out = RowParallelDense(cfg.n_embd, use_bias=cfg.mlp_bias,
+                                    dtype=cfg.dtype, kernel_init=proj_init,
+                                    span="tp.o_proj", name="o_proj")(o)
 
         mlp = self._moe_mlp if self.is_moe else self._mlp
         if cfg.parallel_residual:

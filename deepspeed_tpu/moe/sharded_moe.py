@@ -236,12 +236,19 @@ def moe_dispatch_combine(x: jnp.ndarray,
     expert_in = jnp.einsum("sec,sm->ecm", dispatch.astype(jnp.float32),
                            x.astype(jnp.float32)).astype(dtype)
     if mesh is not None and mesh.size(expert_axis) > 1:
-        expert_in = jax.lax.with_sharding_constraint(
-            expert_in, mesh.sharding(P(expert_axis, None, None)))
-    expert_out = expert_fn(expert_in)
-    if mesh is not None and mesh.size(expert_axis) > 1:
-        expert_out = jax.lax.with_sharding_constraint(
-            expert_out, mesh.sharding(P(expert_axis, None, None)))
+        # comm_overlap: capacity-chunked exchange — chunk i+1's all_to_all
+        # overlaps chunk i's expert FFN; bitwise-exact vs the monolithic
+        # exchange (the FFN is per-token, dispatch/combine einsums stay whole)
+        from ..parallel.overlap import (chunked_expert_exchange,
+                                        get_overlap_config, moe_overlap_chunks)
+        n_chunks = moe_overlap_chunks(get_overlap_config(),
+                                      mesh.size(expert_axis),
+                                      expert_in.shape[1])
+        expert_out = chunked_expert_exchange(
+            expert_in, expert_fn, mesh.sharding(P(expert_axis, None, None)),
+            n_chunks, site="moe.a2a")
+    else:
+        expert_out = expert_fn(expert_in)
     out = jnp.einsum("sec,ecm->sm", combine.astype(jnp.float32),
                      expert_out.astype(jnp.float32))
     return out.astype(dtype)

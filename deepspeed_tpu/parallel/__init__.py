@@ -12,6 +12,19 @@ from .mesh import (
     MESH_AXES,
     BATCH_AXES,
 )
+from .overlap import (
+    OverlapConfig,
+    resolve_overlap_config,
+    set_overlap_config,
+    get_overlap_config,
+    overlap_scope,
+    chunked_allgather_matmul,
+    chunked_matmul_reduce_scatter,
+    allgather_matmul_monolithic,
+    matmul_reduce_scatter_monolithic,
+    row_parallel_dense_apply,
+    RowParallelDense,
+)
 from .topology import (
     ProcessTopology,
     PipeDataParallelTopology,

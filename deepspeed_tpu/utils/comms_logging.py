@@ -103,6 +103,73 @@ class CommsLogger:
         return out
 
 
+class CollectiveSpans:
+    """Trace-time bytes-on-wire accounting for IN-GRAPH collectives by call site.
+
+    XLA-scheduled collectives are invisible to Python timers, but their wire
+    volume is a static function of shapes — each decomposed/monolithic call
+    site (``parallel/overlap.py``, engine grad sync) records its per-dispatch
+    payload when the enclosing computation TRACES. ``summary()`` therefore
+    reports per-trace estimates (one record per compiled call site, not per
+    step); ``overlap_ratio`` is the fraction of recorded bytes moved by
+    overlap-scheduled (chunked ring / pipelined a2a) collectives. Consumed by
+    MonitorMaster events and ``bench.py --overlap``.
+    """
+
+    def __init__(self):
+        self._spans: Dict[str, Dict] = {}
+
+    def reset(self):
+        self._spans.clear()
+
+    def record(self, site: str, comm_op: str, size_bytes: int, n_ranks: int,
+               overlapped: bool):
+        rec = self._spans.setdefault(
+            site, {"op": comm_op, "traces": 0, "bytes_per_call": 0,
+                   "bytes_total": 0, "n_ranks": n_ranks,
+                   "overlapped": bool(overlapped)})
+        rec["traces"] += 1
+        rec["bytes_per_call"] = int(size_bytes)
+        # ACCUMULATE: n_layer traced calls at one site (e.g. every layer's
+        # o_proj) must sum, not overwrite, or totals underreport by ~n_layer
+        rec["bytes_total"] += int(size_bytes)
+        rec["n_ranks"] = int(n_ranks)
+        rec["overlapped"] = bool(overlapped)
+
+    def summary(self) -> Dict[str, Dict]:
+        return {k: dict(v) for k, v in self._spans.items()}
+
+    def total_bytes(self) -> int:
+        return spans_total_bytes(self._spans)
+
+    def overlapped_bytes(self) -> int:
+        return spans_overlapped_bytes(self._spans)
+
+    def overlap_ratio(self) -> float:
+        return spans_overlap_ratio(self._spans)
+
+
+def spans_total_bytes(spans: Dict[str, Dict]) -> int:
+    return sum(v["bytes_total"] for v in spans.values())
+
+
+def spans_overlapped_bytes(spans: Dict[str, Dict]) -> int:
+    return sum(v["bytes_total"] for v in spans.values() if v["overlapped"])
+
+
+def spans_overlap_ratio(spans: Dict[str, Dict]) -> float:
+    total = spans_total_bytes(spans)
+    return (spans_overlapped_bytes(spans) / total) if total else 0.0
+
+
+collective_spans = CollectiveSpans()
+
+
+def record_collective(site: str, comm_op: str, size_bytes: int, n_ranks: int,
+                      overlapped: bool = False):
+    collective_spans.record(site, comm_op, size_bytes, n_ranks, overlapped)
+
+
 def _fmt_size(num_bytes: float) -> str:
     if num_bytes == 0:
         return "0 B"

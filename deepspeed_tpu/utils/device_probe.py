@@ -1,0 +1,108 @@
+"""Hang-proof jax device probe.
+
+``jax.devices()`` on a dead TPU tunnel does not raise — it blocks forever
+inside the PJRT client, wedging whatever process asked. Every entry point
+that must decide "are real chips reachable?" before touching the backend
+(``__graft_entry__.dryrun_multichip``, ``env_report``, ``bench.py
+--overlap``) goes through this one probe instead of rolling its own.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+# Child source for the subprocess probe: ONE backend init yields the whole
+# inventory, so callers that want detail (env_report) don't pay a second init.
+# The child calls back into _inventory_inprocess so both paths share one
+# source of truth for the inventory shape.
+_INVENTORY_SRC = (
+    "import json\n"
+    "from deepspeed_tpu.utils.device_probe import _inventory_inprocess\n"
+    "print(json.dumps(_inventory_inprocess()))\n")
+
+
+def _backend_already_initialized() -> bool:
+    """True iff jax's backend is live IN THIS PROCESS — checked without
+    triggering initialisation (which is the thing that can hang). The
+    ``sys.modules`` fast path keeps the probe import-free when the caller
+    never touched jax (the shim module itself imports jax)."""
+    if "jax" not in sys.modules:
+        return False
+    from .jax_compat import backend_initialized
+    return backend_initialized()
+
+
+def _inventory_inprocess() -> dict:
+    import jax
+    devs = jax.devices()
+    per = []
+    for d in devs[:8]:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        per.append({"id": d.id, "kind": d.device_kind,
+                    "bytes_limit": stats.get("bytes_limit")})
+    return {"platform": devs[0].platform, "device_count": len(devs),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(), "devices": per}
+
+
+def probe_device_inventory(timeout_s: float = 60.0):
+    """Device inventory WITHOUT risking a parent-process hang. Decision order:
+
+    1. env says CPU (``JAX_PLATFORMS=cpu``): the in-process probe is safe and
+       cheap — use it (backend init here is fine, the caller wants CPU anyway);
+    2. backend already initialised in this process: ``jax.devices()`` returns
+       the cached client list and cannot hang — use it (a subprocess probe
+       here would FAIL on real TPUs, the parent holds the exclusive libtpu
+       lock, and misreport a healthy host as dead);
+    3. otherwise probe in a THROWAWAY subprocess with a timeout: a hang or
+       crash kills the child, never the caller.
+
+    Returns the inventory dict (see ``_INVENTORY_SRC``) or ``None`` when the
+    probe timed out/failed — callers treat ``None`` as "no real devices".
+    """
+    if (os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+            or _backend_already_initialized()):
+        try:
+            return _inventory_inprocess()
+        except Exception:
+            return None
+    try:
+        # the parent may have deepspeed_tpu importable only via its own
+        # sys.path — pin the package root so the child's import cannot miss
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", _INVENTORY_SRC],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        if proc.returncode == 0:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, ValueError, IndexError, OSError):
+        pass
+    return None
+
+
+def probe_device_count(timeout_s: float = 60.0) -> int:
+    """Device count via :func:`probe_device_inventory`; 0 on probe failure,
+    which callers treat as "spawn the virtual CPU mesh"."""
+    inv = probe_device_inventory(timeout_s)
+    return 0 if inv is None else inv["device_count"]
+
+
+def virtual_cpu_mesh_env(n_devices: int, base_env=None) -> dict:
+    """Child-process env pinned to an ``n_devices`` virtual CPU mesh: the
+    re-exec recipe shared by ``__graft_entry__.dryrun_multichip`` and
+    ``bench.py --overlap`` (strip any existing host-platform flag, pin CPU)."""
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    xla = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                 env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        xla + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    return env

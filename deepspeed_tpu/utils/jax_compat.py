@@ -9,6 +9,8 @@ model code on ONE spelling:
   in_specs, out_specs, check_rep=, auto=)`` (old). ``axis_names`` lists the
   MANUAL axes; the old API takes the complement (``auto``) instead, and calls
   its replication check ``check_rep``.
+- ``backend_initialized``: is a jax backend live in THIS process, checked
+  without triggering initialisation (which can hang on a dead TPU tunnel).
 """
 
 from typing import Any, Optional, Set
@@ -39,3 +41,23 @@ def shard_map(f, *, mesh, in_specs, out_specs,
     from jax.experimental.shard_map import shard_map as _old
     return _old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_rep=bool(check_vma))
+
+
+def backend_initialized() -> bool:
+    """True iff a jax backend is already live in this process.
+
+    Reads the memoisation cache that ``xla_bridge.backends()`` populates —
+    there is no public "initialised?" predicate (every public surface would
+    trigger the initialisation we must avoid). Getting ``False`` wrong is
+    HARMFUL (device probes would misreport a live TPU host as dead because a
+    subprocess can't take the parent's libtpu lock), so cache-attribute drift
+    on a jax upgrade raises instead of guessing.
+    """
+    try:
+        from jax._src import xla_bridge
+        cache = xla_bridge._backends
+    except (ImportError, AttributeError) as e:
+        raise RuntimeError(
+            "jax_compat.backend_initialized: jax's backend cache moved "
+            f"(installed jax {jax.__version__}) — update this shim") from e
+    return bool(cache)

@@ -31,23 +31,30 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serving: continuous-batching serving lane (scheduler, "
         "KV slot pool, chunked decode, loadgen smoke) — tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "comm_overlap: comm-compute overlap parity lane (chunked "
+        "collective matmuls, quantized allreduce, bench --overlap smoke) — "
+        "tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
-    """The fault-tolerance and serving lanes must land inside tier-1's
-    wall-clock budget — the full suite can overrun it on CPU, and both sort
-    late alphabetically ('tests/unit/runtime', 'tests/unit/inference/serving').
-    Run fault tolerance first, serving second; relative order of everything
-    else is unchanged."""
+    """The fault-tolerance, serving, and comm-overlap lanes must land inside
+    tier-1's wall-clock budget — the full suite can overrun it on CPU, and all
+    three sort late alphabetically ('tests/unit/runtime',
+    'tests/unit/inference/serving', 'tests/unit/parallel'). Run fault
+    tolerance first, serving second, comm-overlap third; relative order of
+    everything else is unchanged."""
 
     def rank(it):
         if "test_fault_tolerance" in it.nodeid:
             return 0
         if "inference/serving" in it.nodeid:
             return 1
-        return 2
+        if it.get_closest_marker("comm_overlap") is not None:
+            return 2
+        return 3
 
-    if any(rank(it) < 2 for it in items):
+    if any(rank(it) < 3 for it in items):
         items.sort(key=rank)        # stable: preserves order within each rank
 
 
