@@ -5,7 +5,7 @@ Mirrors reference ``deepspeed/inference/config.py`` (``DeepSpeedInferenceConfig:
 (mesh data axis for throughput batching) added.
 """
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from pydantic import Field
 
@@ -23,6 +23,35 @@ class QuantConfig(ConfigModel):
     bits: int = 8
 
 
+class WeightQuantConfig(ConfigModel):
+    """Weight-streaming quantized decode (``ops/quantizer/fused_matmul.py``).
+
+    Projection weights (qkv/o_proj/fc_in/fc_out/gate/up + MoE expert FFNs) are
+    stored grouped-quantized and dequantized INSIDE the fused matmul kernels,
+    so int8/int4 bytes are what streams from HBM on the decode hot path.
+    Embeddings, norms, biases and the lm_head stay fp.
+
+    - ``bits``: 8 or 4 (int4 packs two nibbles per byte — 4x weight reads).
+    - ``group``: elements per scale group along the contraction dim.
+    - ``exclude``: parameter-path substrings to keep in bf16 (e.g.
+      ``["layers_0/", "fc_out"]``).
+    - ``outlier_threshold``: per-matrix relative-error audit bound — matrices
+      whose quantize/dequantize relative Frobenius error exceeds it stay bf16
+      (outlier-heavy matrices quantize badly under symmetric grouped scales).
+      ``None`` picks a per-bits default (0.05 for int8, 0.30 for int4).
+    """
+    enabled: bool = False
+    bits: int = 8
+    group: int = 128
+    exclude: List[str] = Field(default_factory=list)
+    outlier_threshold: Optional[float] = None
+
+    def resolved_threshold(self) -> float:
+        if self.outlier_threshold is not None:
+            return float(self.outlier_threshold)
+        return 0.05 if self.bits == 8 else 0.30
+
+
 class InferenceCheckpointConfig(ConfigModel):
     checkpoint_dir: Optional[str] = None
     tag: Optional[str] = None
@@ -38,6 +67,9 @@ class DeepSpeedInferenceConfig(ConfigModel):
     max_batch_size: int = 1
     replace_with_kernel_inject: bool = True
     quant: QuantConfig = Field(default_factory=QuantConfig)
+    # weight-streaming quantized decode; supersedes the legacy ``quant`` block
+    # (which resolves to weight_quant(bits=8) at engine construction)
+    weight_quant: WeightQuantConfig = Field(default_factory=WeightQuantConfig)
     checkpoint: Optional[Any] = None
     replace_method: str = "auto"
     enable_cuda_graph: bool = False               # accepted; AOT decode is always compiled
@@ -68,6 +100,16 @@ class DeepSpeedInferenceConfig(ConfigModel):
         injection (``module_inject/replace_module.py:152``) and kernels dequantize into fp16
         compute (``csrc/transformer/inference/csrc/dequantize.cu``)."""
         return str(self.dtype).replace("torch.", "") == "int8" or self.quant.enabled
+
+    def resolved_weight_quant(self) -> WeightQuantConfig:
+        """One weight-quantization surface: the ``weight_quant`` block wins;
+        the legacy ``quant`` block / ``dtype="int8"`` resolve to its 8-bit
+        defaults so both spellings drive the same per-site kernel path."""
+        if self.weight_quant.enabled:
+            return self.weight_quant
+        if self.is_int8():
+            return WeightQuantConfig(enabled=True, bits=8)
+        return self.weight_quant
 
     def jax_dtype(self):
         import jax.numpy as jnp

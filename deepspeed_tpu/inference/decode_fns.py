@@ -110,6 +110,13 @@ def build_decode_loop(module, dequant, select, gen_cap: int, overlap=None):
     (``InferenceEngine.generate``'s decode shape)."""
 
     def decode_loop_inner(params, tok0, caches, lens, n_new, eos, rng):
+        # HOISTED param prep: on the XLA fallback path ``dequant`` collapses
+        # quant nodes here, OUTSIDE the while_loop — the dequantized weights
+        # become loop constants, computed once per dispatch instead of per
+        # decode step (HLO-pinned: no int8 operands inside the loop body).
+        # On the fused path it is the identity and quantized bytes stream
+        # from HBM inside each step's projection kernels.
+        params = dequant(params)
         b = tok0.shape[0]
         buf = jnp.zeros((b, gen_cap), jnp.int32).at[:, 0].set(tok0[:, 0])
         finished0 = tok0[:, 0] == eos          # eos = -1 when unused: never matches
@@ -122,7 +129,7 @@ def build_decode_loop(module, dequant, select, gen_cap: int, overlap=None):
             i, tok, caches, lens, finished, buf = s
             positions = lens[:, None]
             logits, caches = module.apply(
-                {"params": dequant(params)}, tok, positions=positions,
+                {"params": params}, tok, positions=positions,
                 caches=caches, cache_lens=lens)
             tok = select(logits[:, -1], jax.random.fold_in(rng, i))
             # finished sequences keep emitting eos (HF pad-with-eos behaviour)
@@ -170,13 +177,16 @@ def build_decode_chunk(module, dequant, slot_select, chunk_size: int,
 
     def decode_chunk(params, toks, caches, lens, active, remaining, eos_ids,
                      seeds, steps, base_key):
+        # hoisted out of the fori_loop body — same loop-invariance contract as
+        # build_decode_loop (dequant once per chunk dispatch, not per step)
+        params = dequant(params)
         S = toks.shape[0]
         buf = jnp.zeros((S, chunk_size), jnp.int32)
 
         def body(i, s):
             toks, caches, lens, active, remaining, steps, buf = s
             logits, caches = module.apply(
-                {"params": dequant(params)}, toks, positions=lens[:, None],
+                {"params": params}, toks, positions=lens[:, None],
                 caches=caches, cache_lens=lens)
             nxt = slot_select(logits[:, -1], base_key, seeds, steps)
             tok = jnp.where(active[:, None], nxt,

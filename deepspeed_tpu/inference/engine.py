@@ -142,25 +142,49 @@ class InferenceEngine:
     def _spec_fits(self, shape, spec) -> bool:
         return spec_fits(self.mesh_spec, shape, spec)
 
-    # weight-path names eligible for int8 quantization (matmul kernels; embeddings and
-    # norms stay in fp — reference GroupQuantizer quantizes the same set)
+    # weight-path names eligible for quantization (matmul kernels; embeddings,
+    # norms and the lm_head stay in fp — the head shares the huge-vocab logits
+    # matmul with tied ``wte``, and the reference GroupQuantizer likewise skips
+    # embeddings)
     _QUANT_NAMES = ("q_proj", "k_proj", "v_proj", "o_proj", "fc_in", "fc_out",
-                    "gate_proj", "up_proj", "lm_head")
+                    "gate_proj", "up_proj")
 
     def _shard_params(self):
         self.params = self._place_params(self.params)
 
     def _place_params(self, raw):
-        """Cast to serve dtype, optionally int8-quantize matmul weights (grouped symmetric,
-        reference ``GroupQuantizer``/``dequantize.cu``), and device_put with Megatron TP
-        specs. Quantized leaves become ``{"__int8_q__", "__int8_scale__"}`` nodes that
-        :meth:`_dequant` collapses inside the compiled graph."""
+        """Cast to serve dtype, optionally grouped-quantize matmul weights
+        (``weight_quant`` config block; the legacy ``quant``/``dtype="int8"``
+        spellings resolve to its 8-bit defaults), and device_put with Megatron
+        TP specs.
+
+        Quantized leaves become ``{"__int8_q__"|"__int4_q__", *_scale__}``
+        nodes that stay quantized through the decode hot path: the model's
+        projection sites (``QuantDense``/``RowParallelDense``) feed them to the
+        fused dequant-matmul kernels so int8/int4 bytes are what streams from
+        HBM. On non-TPU backends :meth:`_dequant` collapses the tree once per
+        dispatch instead.
+
+        Every candidate matrix passes a quantize-time relative-error audit
+        (``quantize_with_audit``): outlier-heavy matrices (relative Frobenius
+        error above ``weight_quant.outlier_threshold``) and ``exclude``-listed
+        paths stay in the serve dtype. Decisions — including the EFFECTIVE
+        group size when the requested group does not divide k — land in
+        ``self.quant_audit`` and are logged via ``log_dist`` /
+        :meth:`set_monitor`."""
         specs = causal_lm_param_specs(raw, tensor_axis=AXIS_TENSOR)
         mesh = self.mesh_spec
-        int8 = self._config.is_int8()
-        if int8:
+        if self._config.quant.enabled or self._config.is_int8():
             from ..ops.quantizer import validate_quant_config
             validate_quant_config(self._config.quant)
+        wq = self._config.resolved_weight_quant()
+        if wq.enabled and wq.bits not in (8, 4):
+            raise ValueError(f"weight_quant.bits={wq.bits} not in (8, 4)")
+        if wq.enabled and wq.group < 1:
+            raise ValueError(f"weight_quant.group={wq.group} must be >= 1")
+        self._wq = wq
+        threshold = wq.resolved_threshold()
+        audit = []
         self._raw_template = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), getattr(x, "dtype", np.float32)),
             raw)
@@ -184,23 +208,101 @@ class InferenceEngine:
             arr = jnp.asarray(node)
             if arr.ndim >= 2 and arr.dtype in (jnp.float32, jnp.float16, jnp.bfloat16):
                 arr = arr.astype(self.dtype)
-            if int8 and quantizable(path, arr):
-                from ..ops.quantizer import quantize_grouped
-                q, scale = quantize_grouped(arr)
-                spec_t = tuple(spec_node) + (None,) * (arr.ndim - len(tuple(spec_node)))
-                return {"__int8_q__": put(q, P(*spec_t)),
-                        "__int8_scale__": put(scale.astype(jnp.float32), P(*spec_t))}
+            if wq.enabled and quantizable(path, arr):
+                pstr = "/".join(path)
+                if any(sub in pstr for sub in wq.exclude):
+                    audit.append({"name": pstr, "decision": "excluded",
+                                  "reason": "weight_quant.exclude match",
+                                  "bits": wq.bits, "group_requested": wq.group,
+                                  "group_effective": None, "rel_err": None})
+                else:
+                    from ..ops.quantizer import quantize_with_audit
+                    qnode, info = quantize_with_audit(
+                        arr, bits=wq.bits, group_size=wq.group,
+                        threshold=threshold, name=pstr)
+                    audit.append(info)
+                    if qnode is not None:
+                        spec_t = tuple(spec_node) + \
+                            (None,) * (arr.ndim - len(tuple(spec_node)))
+                        return {k: put(v, P(*spec_t)) for k, v in qnode.items()}
             return put(arr, spec_node)
 
         placed = walk(raw, specs, ())
         self._param_specs = specs
-        self._quantized = int8
+        self.quant_audit = audit
+        n_q = sum(1 for e in audit if e["decision"] == "quantized")
+        self._quantized = wq.enabled and n_q > 0
+        if wq.enabled:
+            for e in audit:
+                if e["decision"] != "quantized":
+                    log_dist(f"weight_quant: {e['name']} kept fp — {e['reason']}",
+                             ranks=[0])
+                elif e["group_effective"] != e["group_requested"]:
+                    log_dist(f"weight_quant: {e['name']} effective group "
+                             f"{e['group_effective']} (requested {wq.group})",
+                             ranks=[0])
+            log_dist(
+                f"weight_quant: int{wq.bits} group={wq.group} — {n_q} matrices "
+                f"quantized, {len(audit) - n_q} kept fp "
+                f"(outlier_threshold={threshold})", ranks=[0])
         return placed
 
+    def weight_stream_report(self) -> Dict[str, float]:
+        """Modeled HBM weight-stream bytes for one full pass over the params
+        (≈ one decode step: every matmul weight read once). Quant nodes use
+        the fused kernel's own block accounting (``node_weight_bytes`` —
+        payload + scales, each block read exactly once). Everything fp — the
+        kept-fp matrices AND the bf16-equivalent of quantized ones — is
+        billed at 2 bytes/elem, so the model describes a bf16 TPU deployment
+        with one consistent denominator regardless of the dtype a CPU test
+        engine happens to serve in. ``reduction_quantized_nodes`` is the
+        kernel-accounting reduction over the quantized set (the bench's
+        modeled bytes-per-step figure); ``reduction_total`` includes the
+        fp-kept matrices (embeddings/lm_head/excluded)."""
+        from ..ops.quantizer import (dense_weight_bytes, is_quant_node,
+                                     node_logical_shape, node_weight_bytes)
+        acc = {"quantized_bytes": 0, "quantized_bf16_equiv": 0, "fp_bytes": 0}
+
+        def walk(node):
+            if is_quant_node(node):
+                acc["quantized_bytes"] += node_weight_bytes(node)
+                acc["quantized_bf16_equiv"] += dense_weight_bytes(
+                    node_logical_shape(node), jnp.bfloat16)
+            elif isinstance(node, dict):
+                for v in node.values():
+                    walk(v)
+            elif getattr(node, "ndim", 0) >= 2:
+                acc["fp_bytes"] += dense_weight_bytes(node.shape, jnp.bfloat16)
+
+        walk(self.params)
+        step = acc["quantized_bytes"] + acc["fp_bytes"]
+        bf16_equiv = acc["quantized_bf16_equiv"] + acc["fp_bytes"]
+        return {
+            **acc,
+            "modeled_step_bytes": step,
+            "bf16_equiv_step_bytes": bf16_equiv,
+            "reduction_total": bf16_equiv / step if step else 1.0,
+            "reduction_quantized_nodes": (
+                acc["quantized_bf16_equiv"] / acc["quantized_bytes"]
+                if acc["quantized_bytes"] else 1.0),
+        }
+
     def _dequant(self, params):
+        """Per-dispatch parameter prep for the compiled-step builders.
+
+        Unquantized: identity. Quantized on the fused backend (TPU, or forced
+        via ``DS_TPU_WQ_FORCE_FUSED=1`` in tests): quant nodes pass through to
+        the per-site fused dequant-matmul kernels — int8/int4 bytes stream
+        from HBM inside the decode loop. Quantized on the XLA fallback backend
+        (CPU hosts, excluded matrices): collapse the tree ONCE here — the
+        builders call this OUTSIDE the compiled loop bodies, so the dequant is
+        loop-invariant (HLO-pinned by ``test_weight_quant.py``) instead of
+        re-derived every while_loop step."""
         if not getattr(self, "_quantized", False):
             return params
-        from ..ops.quantizer import dequantize_tree
+        from ..ops.quantizer import dequantize_tree, fused_backend_active
+        if fused_backend_active():
+            return params
         return dequantize_tree(params, self.dtype)
 
     # ------------------------------------------------------------------ compiled steps
@@ -251,8 +353,24 @@ class InferenceEngine:
     def set_monitor(self, monitor):
         """Attach a :class:`~deepspeed_tpu.monitor.MonitorMaster`; every ``generate``
         then emits ``inference/ttft_ms``, ``inference/tpot_ms`` and
-        ``inference/decode_tokens_per_sec`` events (step = generate-call index)."""
+        ``inference/decode_tokens_per_sec`` events (step = generate-call index).
+        A weight-quantized engine also emits its quantization audit once on
+        attach: matrix decisions and the modeled weight-stream reduction."""
         self._monitor = monitor
+        audit = getattr(self, "quant_audit", None)
+        if monitor is not None and getattr(monitor, "enabled", False) and audit:
+            rep = self.weight_stream_report()
+            n_q = sum(1 for e in audit if e["decision"] == "quantized")
+            monitor.write_events([
+                ("inference/weight_quant/bits", float(self._wq.bits), 0),
+                ("inference/weight_quant/matrices_quantized", float(n_q), 0),
+                ("inference/weight_quant/matrices_kept_fp",
+                 float(len(audit) - n_q), 0),
+                ("inference/weight_quant/modeled_step_bytes",
+                 float(rep["modeled_step_bytes"]), 0),
+                ("inference/weight_quant/reduction_vs_bf16",
+                 float(rep["reduction_total"]), 0),
+            ])
         return self
 
     def _activate(self):

@@ -26,7 +26,8 @@ from jax.sharding import PartitionSpec as P
 from ..ops.attention.decode import decode_attention, decode_attention_xla
 from ..ops.transformer.attention import xla_attention
 from ..parallel.overlap import (RowParallelDense, chunked_expert_exchange,
-                                get_overlap_config, moe_overlap_chunks)
+                                get_overlap_config, moe_overlap_chunks,
+                                raw_or_param)
 from .base import Model
 from ..utils.jax_compat import shard_map
 
@@ -211,10 +212,41 @@ def _act(cfg: CausalLMConfig):
 
 
 # ----------------------------------------------------------------------- modules
+class QuantDense(nn.Module):
+    """Drop-in for ``nn.Dense`` at column-parallel quantizable sites
+    (qkv / fc_in / gate / up).
+
+    Parameter tree (``kernel``/``bias``, fp32) is identical to ``nn.Dense`` —
+    checkpoints and the training path don't change. At serve time the engine
+    may swap ``kernel`` for a quant node; the projection then runs through the
+    fused dequant-matmul kernel (``ops/quantizer/fused_matmul.py``) so
+    int8/int4 bytes are what streams from HBM on the decode hot path."""
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros
+    site: str = "wq.dense"
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = raw_or_param(self, "kernel", self.kernel_init,
+                               (x.shape[-1], self.features))
+        bias = (self.param("bias", self.bias_init, (self.features,),
+                           jnp.float32) if self.use_bias else None)
+        from ..ops.quantizer import is_quant_node, quant_dense_apply
+        if is_quant_node(kernel):
+            return quant_dense_apply(x, kernel, bias, self.dtype,
+                                     parallel="column", site=self.site)
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        return y if bias is None else y + bias.astype(self.dtype)
+
+
 class _ExpertWeights(nn.Module):
     """Param holder producing the same tree as the training ``moe.experts.Experts``
     module (``moe_experts/{w1,b1,w2,b2}``) so trained checkpoints map 1:1; the routing
-    math lives in the caller where it can be vmapped over token chunks."""
+    math lives in the caller where it can be vmapped over token chunks. ``w1``/``w2``
+    may come back as quant nodes at serve time (see :func:`raw_or_param`)."""
     num_experts: int
     d_model: int
     d_ff: int
@@ -224,9 +256,9 @@ class _ExpertWeights(nn.Module):
     def __call__(self):
         e, d, f = self.num_experts, self.d_model, self.d_ff
         init = nn.initializers.normal(self.init_std)
-        return (self.param("w1", init, (e, d, f), jnp.float32),
+        return (raw_or_param(self, "w1", init, (e, d, f)),
                 self.param("b1", nn.initializers.zeros, (e, f), jnp.float32),
-                self.param("w2", init, (e, f, d), jnp.float32),
+                raw_or_param(self, "w2", init, (e, f, d)),
                 self.param("b2", nn.initializers.zeros, (e, d), jnp.float32))
 
 
@@ -237,12 +269,15 @@ class CausalLMLayer(nn.Module):
     def _attn_proj(self, x):
         cfg = self.config
         hd, hk = cfg.head_dim, cfg.kv_heads
-        q = nn.Dense(cfg.n_head * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
-                     kernel_init=nn.initializers.normal(cfg.init_std), name="q_proj")(x)
-        k = nn.Dense(hk * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
-                     kernel_init=nn.initializers.normal(cfg.init_std), name="k_proj")(x)
-        v = nn.Dense(hk * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
-                     kernel_init=nn.initializers.normal(cfg.init_std), name="v_proj")(x)
+        q = QuantDense(cfg.n_head * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
+                       kernel_init=nn.initializers.normal(cfg.init_std),
+                       site="wq.q_proj", name="q_proj")(x)
+        k = QuantDense(hk * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
+                       kernel_init=nn.initializers.normal(cfg.init_std),
+                       site="wq.k_proj", name="k_proj")(x)
+        v = QuantDense(hk * hd, use_bias=cfg.qkv_bias, dtype=cfg.dtype,
+                       kernel_init=nn.initializers.normal(cfg.init_std),
+                       site="wq.v_proj", name="v_proj")(x)
         b, t = x.shape[:2]
         return (q.reshape(b, t, cfg.n_head, hd), k.reshape(b, t, hk, hd),
                 v.reshape(b, t, hk, hd))
@@ -253,14 +288,16 @@ class CausalLMLayer(nn.Module):
         init = nn.initializers.normal(cfg.init_std)
         proj_init = nn.initializers.normal(cfg.init_std / (2 * cfg.n_layer) ** 0.5)
         if cfg.gated_mlp:
-            gate = nn.Dense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                            kernel_init=init, name="gate_proj")(h)
-            up = nn.Dense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                          kernel_init=init, name="up_proj")(h)
+            gate = QuantDense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                              kernel_init=init, site="wq.gate_proj",
+                              name="gate_proj")(h)
+            up = QuantDense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                            kernel_init=init, site="wq.up_proj",
+                            name="up_proj")(h)
             h = act(gate) * up
         else:
-            h = nn.Dense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
-                         kernel_init=init, name="fc_in")(h)
+            h = QuantDense(cfg.ffn_dim, use_bias=cfg.mlp_bias, dtype=cfg.dtype,
+                           kernel_init=init, site="wq.fc_in", name="fc_in")(h)
             h = act(h)
         # row-parallel TP site: lowers to the chunked matmul-reduce-scatter
         # ring when comm_overlap is active (plain matmul + GSPMD allreduce
@@ -298,6 +335,53 @@ class CausalLMLayer(nn.Module):
         cdtype = cfg.dtype
         mesh = get_global_mesh()
         expert_sharded = mesh is not None and mesh.size(AXIS_EXPERT) > 1
+        from ..ops.quantizer import dequantize_node, is_quant_node
+        quant_experts = is_quant_node(w1) or is_quant_node(w2)
+        if (quant_experts and t == 1 and cfg.moe_decode_fastpath
+                and not expert_sharded and cfg.num_experts > cfg.moe_top_k):
+            # quantized decode fast path: gather the SELECTED experts'
+            # int8/int4 bytes from HBM (2-4x less weight traffic than a bf16
+            # gather), dequantize only the gathered slices. Same dispatch-time
+            # impl re-validation as the fp fastpath below; both impl spellings
+            # route here (the quant gather IS the xla-style gather, and the
+            # pallas kernel's BlockSpec streaming doesn't apply to packed
+            # payloads yet)
+            if cfg.moe_decode_impl not in CausalLMConfig.VALID_MOE_DECODE_IMPLS:
+                raise ValueError(
+                    f"moe_decode_impl={cfg.moe_decode_impl!r} is not one of "
+                    f"{CausalLMConfig.VALID_MOE_DECODE_IMPLS}")
+            from ..moe.sharded_moe import topk_select
+            from ..ops.moe import moe_decode_ffn_quant
+            k = cfg.moe_top_k
+            logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)
+            idx, gw = topk_select(logits, k)
+            xk = x.astype(cdtype)
+            if k > 1:
+                xk = jnp.repeat(xk, k, axis=0)
+            y = moe_decode_ffn_quant(xk, idx.reshape(-1), w1, b1, w2, b2, act)
+            out = jnp.einsum("bk,bkm->bm", gw, y.reshape(b, k, d))
+            return out.reshape(b, t, d).astype(h.dtype)
+        if quant_experts:
+            # dispatch path (prefill / expert-sharded / fastpath off): every
+            # expert's FFN runs, so collapse the nodes here — XLA fuses the
+            # dequant into the consuming einsum's operand read. On the XLA
+            # fallback backend the engine hoists this out of compiled decode
+            # bodies (decode_fns); on the FUSED backend the nodes reach this
+            # point inside the loop body and the step streams bf16-equivalent
+            # expert bytes (XLA LICM makes the dequant a loop constant at
+            # best) — t==1 here means that regression is live, so say so
+            if t == 1:
+                from ..ops.quantizer import fused_backend_active
+                if fused_backend_active():
+                    from ..utils.logging import log_dist
+                    log_dist(
+                        "weight_quant[moe_experts]: quantized experts on the "
+                        "decode DISPATCH path (expert-sharded or fastpath "
+                        "off) — dequantized in the loop body, no weight-"
+                        "stream win; consider weight_quant.exclude for "
+                        "expert FFNs in this topology", ranks=[0])
+            w1 = dequantize_node(w1) if is_quant_node(w1) else w1
+            w2 = dequantize_node(w2) if is_quant_node(w2) else w2
         if (t == 1 and cfg.moe_decode_fastpath and not expert_sharded
                 and cfg.num_experts > cfg.moe_top_k):
             # decode fast path: a (b, 1, d) step touches at most b*k experts; the

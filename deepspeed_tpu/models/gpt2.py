@@ -287,6 +287,45 @@ def block_sp_apply(cfg: GPT2Config, sp: int, axis: str):
     return apply
 
 
+def _pin_batch_sharding(x):
+    """Pin ``(b, t, d)`` activations to batch sharding over the present batch
+    axes. The ZeRO-sharded embedding/layernorm params otherwise hand GSPMD
+    conflicting sharding preferences for the layer carry (an fsdp-sharded
+    feature dim vs the batch-sharded inputs), and it resolves them with an
+    "Involuntary full rematerialization" replicate-reshard (the same failure
+    mode — and fix — as ``moe/layer.py``'s token pinning). No-op without an
+    installed mesh. Only called from the module-level ``GPT2`` forward, never
+    from ``Block`` — the pipe engine wraps ``Block`` in manual shard_map
+    regions where these axis names are not GSPMD-visible."""
+    from ..parallel.mesh import AXIS_SEQ, BATCH_AXES, get_global_mesh
+    mesh = get_global_mesh()
+    if mesh is None:
+        return x
+    axes = tuple(ax for ax in BATCH_AXES if mesh.size(ax) > 1)
+    if not axes and mesh.size(AXIS_SEQ) <= 1:
+        return x
+    # the seq dim keeps its context-parallel sharding (Ulysses) — pinning it
+    # to replicated would itself conflict with the attention's a2a layout
+    return jax.lax.with_sharding_constraint(
+        x, mesh.sharding(mesh.batch_spec(extra_dims=x.ndim - 1,
+                                         shard_seq_dim=1)))
+
+
+def _pin_replicated(w):
+    """Pin a parameter to full replication at a USE site. The embedding gather
+    reads the whole ``wte`` row-wise; letting GSPMD keep the table's ZeRO/TP
+    sharding on the gather operand makes the gather OUTPUT inherit a sharded
+    feature dim, which then full-remats against the batch-sharded carry. The
+    table is all-gathered for the row gather either way — pinning just makes
+    the output sharding unconstrained instead of conflicting."""
+    from ..parallel.mesh import get_global_mesh
+    mesh = get_global_mesh()
+    if mesh is None:
+        return w
+    return jax.lax.with_sharding_constraint(
+        w, mesh.sharding(P(*([None] * w.ndim))))
+
+
 class GPT2(nn.Module):
     config: GPT2Config
 
@@ -302,8 +341,10 @@ class GPT2(nn.Module):
                          (cfg.vocab_size, cfg.n_embd), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(cfg.init_std),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
-        x = wte[input_ids].astype(cfg.dtype) + wpe[:t][None].astype(cfg.dtype)
+        x = _pin_replicated(wte)[input_ids].astype(cfg.dtype) + \
+            wpe[:t][None].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
+        x = _pin_batch_sharding(x)
 
         block = Block
         if cfg.remat:
@@ -312,7 +353,8 @@ class GPT2(nn.Module):
             block = nn.remat(Block, prevent_cse=False, static_argnums=(2,), policy=policy)
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, deterministic), None),
+                lambda mdl, carry, _: (
+                    _pin_batch_sharding(mdl(carry, deterministic)), None),
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layer,
@@ -320,7 +362,7 @@ class GPT2(nn.Module):
             )(block(cfg, name="h"), x, None)
         else:
             for i in range(cfg.n_layer):
-                x = block(cfg, name=f"h_{i}")(x, deterministic)
+                x = _pin_batch_sharding(block(cfg, name=f"h_{i}")(x, deterministic))
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:

@@ -93,15 +93,18 @@ class GPT2MoE(nn.Module):
                          (cfg.vocab_size, cfg.n_embd), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(cfg.init_std),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
-        x = wte[input_ids].astype(cfg.dtype) + wpe[:t][None].astype(cfg.dtype)
+        from .gpt2 import Block, _pin_batch_sharding, _pin_replicated
+        x = _pin_replicated(wte)[input_ids].astype(cfg.dtype) + \
+            wpe[:t][None].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
+        x = _pin_batch_sharding(x)
 
-        from .gpt2 import Block
         for i in range(cfg.n_layer):
             if (i + 1) % cfg.moe_layer_interval == 0:
                 x = MoEBlock(cfg, name=f"h_moe_{i}")(x, deterministic)
             else:
                 x = Block(cfg, name=f"h_{i}")(x, deterministic)
+            x = _pin_batch_sharding(x)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return x.astype(jnp.float32) @ wte.T

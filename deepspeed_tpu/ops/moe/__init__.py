@@ -1,3 +1,3 @@
-from .decode_ffn import moe_decode_ffn, moe_decode_ffn_xla
+from .decode_ffn import moe_decode_ffn, moe_decode_ffn_quant, moe_decode_ffn_xla
 
-__all__ = ["moe_decode_ffn", "moe_decode_ffn_xla"]
+__all__ = ["moe_decode_ffn", "moe_decode_ffn_quant", "moe_decode_ffn_xla"]

@@ -1,2 +1,9 @@
-from .quant import (DEFAULT_GROUP, INT8_Q, INT8_SCALE, dequantize_grouped,
-                    dequantize_tree, quantize_grouped, validate_quant_config)
+from .quant import (DEFAULT_GROUP, INT4_Q, INT4_SCALE, INT8_Q, INT8_SCALE,
+                    dequantize_grouped, dequantize_node, dequantize_tree,
+                    is_quant_node, make_quant_node, node_bits,
+                    node_logical_shape, node_qs, pack_int4, quantize_grouped,
+                    quantize_with_audit, unpack_int4, validate_quant_config)
+from .fused_matmul import (dense_weight_bytes, force_fused,
+                           fused_backend_active, node_weight_bytes,
+                           quant_dense_apply, quantized_matmul,
+                           quantized_matmul_xla)

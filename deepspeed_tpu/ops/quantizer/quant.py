@@ -1,46 +1,77 @@
-"""Grouped symmetric int8 weight quantization.
+"""Grouped symmetric int8/int4 weight quantization.
 
 TPU-native analogue of the reference's quantization kernels
 (``csrc/quantization/quantize.cu`` / ``dequantize.cu``) and the injection-time
 ``GroupQuantizer`` (``module_inject/replace_module.py:152``): weights are quantized per
 group along the contraction (input) dimension with one fp scale per group per output
-column; dequantisation happens in the compiled graph where XLA fuses it into the
-consumer. Storage and HBM reads of the weight halve (int8 vs bf16).
+column; dequantisation happens either inside the fused Pallas matmul kernels
+(``fused_matmul.py`` — int8/int4 bytes are what streams from HBM) or, on the XLA
+fallback path, once per dispatch where XLA fuses it into the consumer. Storage and
+HBM reads of the weight shrink 2x (int8) / 4x (int4) vs bf16, plus the per-group
+scale overhead (4/group bytes per element).
+
+int4 storage packs two nibbles per int8 byte (``pack_int4``/``unpack_int4``) with a
+*per-group split-half* layout: within each scale group of ``g`` rows, byte row ``j``
+(``j < g/2``) holds logical row ``j`` in its low nibble and row ``j + g/2`` in its
+high nibble. Unpacking is then a concat along the (sublane) row axis — no interleave
+— and a TP shard whose row range covers whole groups unpacks locally without
+neighbour data.
 """
 
-from typing import Tuple
+from collections.abc import Mapping
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
+
+from ...utils.logging import log_dist
 
 DEFAULT_GROUP = 128
 
 
-def _group_size(k: int, group_size: int) -> int:
+def _group_size(k: int, group_size: int, *, warn_for: Optional[str] = None) -> int:
     g = min(group_size, k)
     while k % g:
         g //= 2
-    return max(g, 1)
+    g = max(g, 1)
+    if warn_for is not None and g < min(group_size, k):
+        # silent degradation to tiny groups bloats the scale tensor (4/g bytes
+        # per element) and, at g == 1, erases the storage win entirely — say so
+        import logging
+        log_dist(
+            f"quantize[{warn_for}]: requested group {group_size} does not "
+            f"divide k={k}; effective group degraded to {g} "
+            f"(scale overhead {4.0 / g:.3f} B/elem)",
+            ranks=[0], level=logging.WARNING)
+    return g
 
 
-def quantize_grouped(w, group_size: int = DEFAULT_GROUP) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def quantize_grouped(w, group_size: int = DEFAULT_GROUP, bits: int = 8,
+                     warn_for: Optional[str] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """w: (..., k, n) → (q int8 (..., k, n), scales f32 (..., k//g, n)).
 
     Groups run along the second-to-last (contraction) dim; symmetric, zero-point-free —
-    the reference's symmetric mode (``quantize.cu`` Symmetric kernels).
+    the reference's symmetric mode (``quantize.cu`` Symmetric kernels). ``bits=4``
+    clips to [-7, 7] (values still land in an int8 carrier; see :func:`pack_int4`
+    for the 2-nibbles-per-byte storage form).
     """
+    if bits not in (8, 4):
+        raise ValueError(f"quantize_grouped: bits={bits} not in (8, 4)")
     w = jnp.asarray(w)
     k, n = w.shape[-2], w.shape[-1]
-    g = _group_size(k, group_size)
+    g = _group_size(k, group_size, warn_for=warn_for)
     lead = w.shape[:-2]
+    qmax = 127.0 if bits == 8 else 7.0
     wg = w.reshape(*lead, k // g, g, n).astype(jnp.float32)
     amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)          # (..., k//g, 1, n)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(wg / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(wg / scale), -qmax, qmax).astype(jnp.int8)
     return q.reshape(*lead, k, n), scale[..., 0, :]
 
 
 def dequantize_grouped(q, scales) -> jnp.ndarray:
-    """Inverse of :func:`quantize_grouped`; returns f32 (cast at the consumer)."""
+    """Inverse of :func:`quantize_grouped` (unpacked int8 carrier); returns f32
+    (cast at the consumer)."""
     k, n = q.shape[-2], q.shape[-1]
     groups = scales.shape[-2]
     g = k // groups
@@ -49,31 +80,169 @@ def dequantize_grouped(q, scales) -> jnp.ndarray:
     return (wg * scales[..., :, None, :]).reshape(*lead, k, n)
 
 
+# ------------------------------------------------------------------ int4 packing
+def pack_int4(q, groups: int) -> jnp.ndarray:
+    """Pack int4 values (int8 carrier in [-7, 7], shape (..., k, n)) two nibbles
+    per byte → (..., k//2, n) int8, per-group split-half layout (see module
+    docstring). ``groups`` is the scale-group count along k; the per-group size
+    ``g = k // groups`` must be even."""
+    q = jnp.asarray(q)
+    k, n = q.shape[-2], q.shape[-1]
+    g = k // groups
+    if k % groups or g % 2:
+        raise ValueError(
+            f"pack_int4: group size k/groups = {k}/{groups} must be an even "
+            "integer (two nibbles pack across each group's halves)")
+    lead = q.shape[:-2]
+    qg = q.reshape(*lead, groups, g, n)
+    lo = qg[..., : g // 2, :]
+    hi = qg[..., g // 2:, :]
+    packed = ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+    return packed.reshape(*lead, k // 2, n)
+
+
+def unpack_int4(packed, groups: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4`: (..., k//2, n) int8 → (..., k, n) int8 in
+    [-7, 7] (sign-extended nibbles)."""
+    packed = jnp.asarray(packed)
+    k2, n = packed.shape[-2], packed.shape[-1]
+    k = 2 * k2
+    g = k // groups
+    lead = packed.shape[:-2]
+    pg = packed.reshape(*lead, groups, g // 2, n)
+    lo = ((pg << 4) >> 4).astype(jnp.int8)       # arithmetic: sign-extends low nibble
+    hi = (pg >> 4).astype(jnp.int8)              # arithmetic: high nibble w/ sign
+    return jnp.concatenate([lo, hi], axis=-2).reshape(*lead, k, n)
+
+
 # --------------------------------------------------------- engine tree helpers
 INT8_Q = "__int8_q__"
 INT8_SCALE = "__int8_scale__"
+INT4_Q = "__int4_q__"
+INT4_SCALE = "__int4_scale__"
+
+
+def make_quant_node(q, scales, bits: int) -> dict:
+    if bits == 8:
+        return {INT8_Q: q, INT8_SCALE: scales}
+    return {INT4_Q: q, INT4_SCALE: scales}
+
+
+def is_quant_node(node) -> bool:
+    # Mapping, not dict: flax hands params back as FrozenDict views on some
+    # paths, and the model-side projection modules must still recognise a node
+    return isinstance(node, Mapping) and (INT8_Q in node or INT4_Q in node)
+
+
+def node_bits(node) -> int:
+    return 8 if INT8_Q in node else 4
+
+
+def node_qs(node) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(quantized payload, scales) of a quant node."""
+    if INT8_Q in node:
+        return node[INT8_Q], node[INT8_SCALE]
+    return node[INT4_Q], node[INT4_SCALE]
+
+
+def node_logical_shape(node) -> Tuple[int, ...]:
+    """The (..., k, n) shape of the bf16 weight a quant node stands in for."""
+    q, _ = node_qs(node)
+    if node_bits(node) == 4:
+        return q.shape[:-2] + (2 * q.shape[-2], q.shape[-1])
+    return tuple(q.shape)
+
+
+def dequantize_node(node) -> jnp.ndarray:
+    """Collapse a quant node to the f32 weight (cast at the consumer)."""
+    q, scales = node_qs(node)
+    if node_bits(node) == 4:
+        q = unpack_int4(q, scales.shape[-2])
+    return dequantize_grouped(q, scales)
+
+
+def quantize_with_audit(w, *, bits: int, group_size: int, threshold: float,
+                        name: str):
+    """Quantize one matrix with a relative-error audit.
+
+    Returns ``(node_or_None, info)``. ``node`` is the engine-tree quant node
+    (int4 payload packed) or ``None`` when the matrix must stay fp; ``info``
+    records the decision for the engine's quantization audit log:
+    ``{name, decision, reason, bits, group_requested, group_effective,
+    rel_err}``. Decisions:
+
+    - ``quantized``   — peak-masked relative Frobenius error under
+      ``threshold``;
+    - ``excluded``    — outlier-heavy (error over ``threshold``): symmetric
+      grouped scales burn their whole grid on the outlier, so the matrix is
+      kept in bf16 (the fp read costs 2 bytes/elem but the numerics survive);
+    - ``excluded`` (odd group) — ``bits=4`` but the effective group is odd so
+      the split-half nibble packing cannot apply; kept fp rather than
+      silently serving an int8-sized int4 carrier.
+
+    The error metric masks out each group's scale-setting peak from BOTH the
+    error and the reference: an outlier quantizes near-exactly (it IS the
+    scale) while zeroing everything else in its group, so the plain
+    whole-matrix relative error goes to ~0 exactly when the damage is worst.
+    The masked form measures what the grid does to the non-peak mass.
+    """
+    w = jnp.asarray(w).astype(jnp.float32)
+    k = w.shape[-2]
+    g = _group_size(k, group_size, warn_for=name)
+    info = {"name": name, "bits": bits, "group_requested": group_size,
+            "group_effective": g}
+    if bits == 4 and g % 2:
+        # decided before any quantize/norm work: the matrix stays fp
+        # regardless of its error, so don't burn two host-synced Frobenius
+        # norms per matrix on a 7B tree
+        info.update(rel_err=None, decision="excluded",
+                    reason=f"effective group {g} is odd — int4 split-half "
+                    "packing needs an even group; kept fp")
+        return None, info
+    q, s = quantize_grouped(w, group_size, bits=bits)
+    lead = w.shape[:-2]
+    n = w.shape[-1]
+    wg = w.reshape(*lead, k // g, g, n)
+    eg = (dequantize_grouped(q, s) - w).reshape(*lead, k // g, g, n)
+    keep = jnp.abs(wg) < jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
+    err = jnp.linalg.norm((eg * keep).reshape(-1))
+    ref = jnp.linalg.norm((wg * keep).reshape(-1))
+    rel = float(err) / max(float(ref), 1e-12)
+    info["rel_err"] = rel
+    if rel > threshold:
+        info.update(decision="excluded", reason=f"rel_err {rel:.4f} > "
+                    f"outlier_threshold {threshold:.4f}")
+        return None, info
+    if bits == 4:
+        q = pack_int4(q, s.shape[-2])
+    info.update(decision="quantized", reason="")
+    return make_quant_node(q, s.astype(jnp.float32), bits), info
 
 
 def validate_quant_config(quant_cfg) -> None:
-    """Serving engines support 8-bit grouped quantization only — reject other
-    widths loudly instead of silently serving 8-bit (``QuantConfig.bits``)."""
+    """The legacy ``quant`` block (and ``dtype="int8"``) selects 8-bit grouped
+    quantization only — reject other widths loudly instead of silently serving
+    8-bit (``QuantConfig.bits``). 4-bit lives behind the ``weight_quant`` block
+    where group/exclude/outlier controls exist to keep it accurate."""
     bits = getattr(quant_cfg, "bits", 8)
     if getattr(quant_cfg, "enabled", False) and bits != 8:
         raise NotImplementedError(
-            f"quant.bits={bits} requested but only 8-bit grouped weight "
-            "quantization is wired (reference GroupQuantizer is 8-bit too)")
+            f"quant.bits={bits} requested but the legacy quant block is 8-bit "
+            "grouped only (reference GroupQuantizer is 8-bit too) — use the "
+            "weight_quant config block for int4")
 
 
 def dequantize_tree(params, dtype):
-    """Collapse ``{__int8_q__, __int8_scale__}`` nodes to fp weights inside a
+    """Collapse every quant node (int8 and packed int4) to fp weights inside a
     traced computation (XLA fuses the dequant into the consuming matmul's
     operand read). Shared by the decoder and encoder inference engines so the
-    int8 node contract cannot drift between them."""
+    quant node contract cannot drift between them. The serving decode path
+    hoists this OUT of compiled loop bodies (``decode_fns`` builders call it
+    once per dispatch) and keeps fused-kernel-eligible nodes quantized."""
     def walk(node):
         if isinstance(node, dict):
-            if INT8_Q in node:
-                return dequantize_grouped(
-                    node[INT8_Q], node[INT8_SCALE]).astype(dtype)
+            if is_quant_node(node):
+                return dequantize_node(node).astype(dtype)
             return {k: walk(v) for k, v in node.items()}
         return node
 

@@ -360,8 +360,31 @@ def row_parallel_dense_apply(x, kernel, bias, dtype, *, site: str = "tp.row_dens
 import flax.linen as nn  # noqa: E402  (after jax; mirrors models/* import order)
 
 
+def raw_or_param(mdl: nn.Module, name: str, init_fn, shape):
+    """Declare a weight at init; RAW-fetch it at apply.
+
+    The serving engine replaces quantizable kernels with quant nodes
+    (``{__int8_q__|__int4_q__, *_scale__}`` — ``ops/quantizer``). Those must
+    flow through flax untouched: ``self.param`` re-runs the initializer under
+    ``eval_shape`` and zips leaf shapes, which a packed int4 payload
+    (``(k//2, n)``) fails. Raw scope access skips that validation; the fp
+    (training/unquantized) tree is bit-identical either way. Shared by every
+    quantizable projection module (:class:`RowParallelDense` here,
+    ``QuantDense``/``_ExpertWeights`` in ``models/causal_lm.py``)."""
+    if mdl.is_initializing() or not mdl.has_variable("params", name):
+        return mdl.param(name, init_fn, shape, jnp.float32)
+    return mdl.scope.get_variable("params", name)
+
+
 class RowParallelDense(nn.Module):
-    """Drop-in for ``nn.Dense`` at row-parallel TP sites (o_proj / fc_out)."""
+    """Drop-in for ``nn.Dense`` at row-parallel TP sites (o_proj / fc_out).
+
+    At serve time the engine may replace ``kernel`` with a quant node
+    (``ops/quantizer``): the projection then runs the fused dequant-matmul
+    kernel with ONE monolithic psum — the chunked comm-overlap ring
+    deliberately does not compose with the quantized kernel (the ring would
+    re-slice the packed payload mid-group), so quantized row-parallel falls
+    back to the monolithic collective even when ``comm_overlap`` is on."""
     features: int
     use_bias: bool = True
     dtype: Any = jnp.float32
@@ -371,14 +394,20 @@ class RowParallelDense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel = self.param("kernel", self.kernel_init,
-                            (x.shape[-1], self.features), jnp.float32)
+        kernel = raw_or_param(self, "kernel", self.kernel_init,
+                              (x.shape[-1], self.features))
         bias = (self.param("bias", self.bias_init, (self.features,), jnp.float32)
                 if self.use_bias else None)
         squeeze = x.ndim == 2
         if squeeze:
             x = x[:, None]
-        y = row_parallel_dense_apply(x, kernel, bias, self.dtype, site=self.span)
+        from ..ops.quantizer import is_quant_node, quant_dense_apply
+        if is_quant_node(kernel):
+            y = quant_dense_apply(x, kernel, bias, self.dtype, parallel="row",
+                                  site=self.span)
+        else:
+            y = row_parallel_dense_apply(x, kernel, bias, self.dtype,
+                                         site=self.span)
         return y[:, 0] if squeeze else y
 
 

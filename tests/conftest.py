@@ -35,14 +35,19 @@ def pytest_configure(config):
         "markers", "comm_overlap: comm-compute overlap parity lane (chunked "
         "collective matmuls, quantized allreduce, bench --overlap smoke) — "
         "tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "weight_quant: weight-streaming quantized decode lane "
+        "(int4 packing, fused dequant-matmul parity, audit, bench --wq "
+        "smoke) — tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
-    """The fault-tolerance, serving, and comm-overlap lanes must land inside
-    tier-1's wall-clock budget — the full suite can overrun it on CPU, and all
-    three sort late alphabetically ('tests/unit/runtime',
-    'tests/unit/inference/serving', 'tests/unit/parallel'). Run fault
-    tolerance first, serving second, comm-overlap third; relative order of
+    """The fault-tolerance, serving, comm-overlap, and weight-quant lanes must
+    land inside tier-1's wall-clock budget — the full suite can overrun it on
+    CPU, and all of them sort late alphabetically ('tests/unit/runtime',
+    'tests/unit/inference/serving', 'tests/unit/parallel',
+    'tests/unit/ops/test_weight_quant'). Run fault tolerance first, serving
+    second, comm-overlap third, weight-quant fourth; relative order of
     everything else is unchanged."""
 
     def rank(it):
@@ -52,9 +57,11 @@ def pytest_collection_modifyitems(config, items):
             return 1
         if it.get_closest_marker("comm_overlap") is not None:
             return 2
-        return 3
+        if it.get_closest_marker("weight_quant") is not None:
+            return 3
+        return 4
 
-    if any(rank(it) < 3 for it in items):
+    if any(rank(it) < 4 for it in items):
         items.sort(key=rank)        # stable: preserves order within each rank
 
 
