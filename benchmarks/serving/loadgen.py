@@ -1,12 +1,23 @@
-"""Serving load generator: Poisson arrivals through the continuous-batching
-scheduler, BENCH-style JSON on stdout.
+"""Serving load generator + chaos soak harness: Poisson arrivals through the
+continuous-batching scheduler — or, with ``--replicas N``, through the
+multi-replica router under scheduled fault injection — BENCH-style JSON on
+stdout.
 
-Drives the real scheduler (admission, backpressure, slot recycling) with
-open-loop traffic: request arrival times are drawn from an exponential
-inter-arrival distribution and submitted when wall clock passes them; rejected
-(queue-full) submissions are retried after the scheduler's ``retry_after`` hint —
-so the emitted throughput numbers include admission-control effects, not just raw
-decode speed.
+Drives the real frontend (admission, backpressure, slot recycling, and in
+router mode health supervision + checkpointless retry) with open-loop traffic:
+request arrival times are drawn from an exponential inter-arrival distribution
+and submitted when wall clock passes them. A rejected (queue-full) submission is
+never dropped: the client honours ``QueueFullError.retry_after`` with jittered
+backoff (``retry_after * (0.5 + U[0,1))``, per request — no head-of-line
+thundering herd) and resubmits. Emitted throughput therefore includes
+admission-control effects, not just raw decode speed.
+
+Chaos soak (``--replicas >= 2 --chaos "<spec>"``, grammar in
+``inference.serving.chaos``): scheduled replica kills/stalls run against the
+router mid-load; the BENCH JSON then carries the no-loss accounting —
+``retried`` / ``evicted`` / ``lost`` (the run fails unless ``lost == 0``) — and,
+for greedy runs, ``parity_ok``: every evicted-and-retried request's final output
+is re-checked bit-identical against an unkilled per-request ``generate``.
 
 ``--smoke`` shrinks everything (tiny model, few requests) to a seconds-long run —
 the mode the serving tests execute in-process.
@@ -30,7 +41,7 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 
-def build_engine(args):
+def build_engine(args, params=None):
     import jax.numpy as jnp
 
     import deepspeed_tpu as ds
@@ -40,10 +51,10 @@ def build_engine(args):
                    n_embd=args.n_embd, n_layer=args.n_layer, n_head=args.n_head,
                    dtype=jnp.float32 if args.dtype == "float32" else jnp.bfloat16)
     return InferenceEngine(cfg, ds.inference.DeepSpeedInferenceConfig(
-        dtype=args.dtype, max_out_tokens=args.max_seq_len))
+        dtype=args.dtype, max_out_tokens=args.max_seq_len), params=params)
 
 
-def run_load(sched, args) -> dict:
+def run_load(front, args, chaos=None) -> dict:
     from deepspeed_tpu.inference.serving import QueueFullError
     rng = np.random.default_rng(args.seed)
     n = args.requests
@@ -56,38 +67,65 @@ def run_load(sched, args) -> dict:
     inter = rng.exponential(1.0 / args.rate, size=n)
     t0 = time.monotonic()
     arrivals = t0 + np.cumsum(inter)
-    handles, i = [], 0
-    not_before = 0.0
-    rejections = 0
-    while i < n or sched.busy:
+    # pending entries are mutable [ready_time, idx]: a rejected request backs
+    # off independently (jittered), it never blocks later arrivals
+    pending = [[float(arrivals[i]), i] for i in range(n)]
+    handles = {}
+    resubmits = 0
+    while pending or front.busy:
+        if chaos is not None:
+            chaos.poll(front)
         now = time.monotonic()
-        while i < n and arrivals[i] <= now and now >= not_before:
+        for entry in [e for e in pending if e[0] <= now]:
+            idx = entry[1]
             try:
-                handles.append(sched.submit(prompts[i],
-                                            max_new_tokens=max_news[i],
-                                            seed=i))
-                i += 1
-            except QueueFullError as e:     # backpressure: honour retry_after
-                rejections += 1
-                not_before = now + e.retry_after
-                break
-        if sched.busy:
-            sched.step()
-        else:
+                handles[idx] = front.submit(prompts[idx],
+                                            max_new_tokens=max_news[idx],
+                                            seed=idx)
+                pending.remove(entry)
+            except QueueFullError as e:   # backpressure: jittered client retry
+                resubmits += 1
+                entry[0] = now + e.retry_after * (0.5 + float(rng.random()))
+        if front.busy:
+            front.step()
+        elif pending:
             # idle: sleep to the next event (arrival / retry window) instead of
             # spinning step() — a busy-wait would burn a core and fold its own
             # overhead into the latency numbers this benchmark reports
-            targets = [arrivals[i]] if i < n else []
-            if not_before > time.monotonic():
-                targets.append(not_before)
-            if targets:
-                time.sleep(max(0.0, min(targets) - time.monotonic()))
+            time.sleep(max(0.0, min(e[0] for e in pending) - time.monotonic()))
     wall = time.monotonic() - t0
-    snap = sched.telemetry.snapshot()
+    is_router = hasattr(front, "replicas")
+    snap = front.snapshot() if is_router else front.telemetry.snapshot()
     snap["wall_s"] = wall
     snap["submitted"] = len(handles)
-    snap["backpressure_events"] = rejections
-    snap["all_finished"] = all(h.done for h in handles)
+    snap["backpressure_events"] = resubmits      # client-side resubmissions
+    snap["all_finished"] = all(h.done for h in handles.values())
+    # no-loss accounting, present on BOTH paths (router already carries its own
+    # retried/evicted; the single scheduler never retries)
+    snap.setdefault("retried", 0)
+    snap.setdefault("evicted", 0)
+    if "lost" not in snap:
+        snap["lost"] = (snap["submitted"] - snap.get("completed", 0)
+                        - snap.get("cancelled", 0) - snap.get("expired", 0))
+    if is_router:
+        snap["tokens_per_sec"] = (snap["tokens_total"] / wall
+                                  if wall > 0 else 0.0)
+        # greedy chaos acceptance: every request that survived an eviction must
+        # end bit-identical to an unkilled per-request generate
+        if chaos is not None:
+            ref_engine = front.replicas[0].engine
+            verified, parity_ok = 0, True
+            for idx, h in handles.items():
+                if h.retried == 0 and h.evictions == 0:
+                    continue
+                ref = np.asarray(ref_engine.generate(
+                    prompts[idx][None, :], max_new_tokens=max_news[idx]))
+                verified += 1
+                if not np.array_equal(h.result(),
+                                      ref[0, prompts[idx].size:]):
+                    parity_ok = False
+            snap["parity_checked"] = verified
+            snap["parity_ok"] = parity_ok
     return snap
 
 
@@ -111,6 +149,17 @@ def main(argv=None) -> int:
     ap.add_argument("--dtype", default="float32",
                     choices=("float32", "bfloat16"))
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help=">=2 drives the multi-replica router")
+    ap.add_argument("--chaos", default=None,
+                    help="chaos spec (see inference.serving.chaos), e.g. "
+                         "'kill:replica=1,when=busy;"
+                         "stall:replica=0,when=busy,s=0.8'")
+    ap.add_argument("--chunk-deadline", type=float, default=None,
+                    help="per-chunk watchdog deadline in seconds "
+                         "(defaults to 0.3 in chaos mode)")
+    ap.add_argument("--jsonl-metrics", default=None,
+                    help="directory for the jsonl monitor backend")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-long tiny-model run (used by the test suite)")
     args = ap.parse_args(argv)
@@ -122,19 +171,57 @@ def main(argv=None) -> int:
         args.min_new, args.max_new = 2, 6
         args.vocab_size, args.max_seq_len = 96, 32
         args.n_embd, args.n_layer, args.n_head = 32, 2, 4
+        if args.chaos:
+            # the soak needs enough in-flight decode for kills/stalls to land
+            # mid-request: longer generations, capacity for the retries
+            args.requests, args.max_queue = 8, 8
+            args.min_new, args.max_new, args.max_seq_len = 10, 16, 64
+    if args.chaos and args.replicas < 2:
+        ap.error("--chaos needs --replicas >= 2")
+    if args.chaos and args.chunk_deadline is None:
+        args.chunk_deadline = 0.3
+
+    from deepspeed_tpu.utils.fault_injection import apply_fault_env
+    apply_fault_env()           # seeded schedule from a parent chaos harness
 
     from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
                                                  ServingConfig)
-    engine = build_engine(args)
-    sched = ContinuousBatchingScheduler(engine, ServingConfig(
+    monitor = None
+    if args.jsonl_metrics:
+        from deepspeed_tpu.config.config import MonitorConfig
+        from deepspeed_tpu.monitor import MonitorMaster
+        monitor = MonitorMaster(MonitorConfig(jsonl_monitor={
+            "enabled": True, "output_path": args.jsonl_metrics,
+            "job_name": "loadgen"}))
+    serving_cfg = ServingConfig(
         slots=args.slots, chunk_size=args.chunk_size, max_queue=args.max_queue,
-        max_seq_len=args.max_seq_len))
-    detail = run_load(sched, args)
+        max_seq_len=args.max_seq_len, chunk_deadline_s=args.chunk_deadline)
+    chaos = None
+    if args.replicas > 1:
+        from deepspeed_tpu.inference.serving import (ChaosSchedule, Router,
+                                                     RouterConfig, parse_chaos)
+        first = build_engine(args)
+        engines = [first] + [build_engine(args, params=first.params)
+                             for _ in range(args.replicas - 1)]
+        rcfg = RouterConfig(serving=serving_cfg, max_queue=args.max_queue)
+        if args.smoke:
+            rcfg.suspect_after_s, rcfg.dead_after_s = 0.05, 0.15
+            rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
+        front = Router(engines, rcfg, monitor=monitor)
+        if args.chaos:
+            chaos = ChaosSchedule(parse_chaos(args.chaos))
+    else:
+        front = ContinuousBatchingScheduler(build_engine(args), serving_cfg,
+                                            monitor=monitor)
+    detail = run_load(front, args, chaos=chaos)
     out = {"metric": "serving_tokens_per_sec",
            "value": detail["tokens_per_sec"], "unit": "tok/s",
-           "vs_baseline": 0.0, "smoke": bool(args.smoke), "detail": detail}
+           "vs_baseline": 0.0, "smoke": bool(args.smoke),
+           "chaos": args.chaos, "detail": detail}
     print(json.dumps(out))
-    return 0 if detail["all_finished"] else 1
+    ok = detail["all_finished"] and detail["lost"] == 0 \
+        and detail.get("parity_ok", True)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

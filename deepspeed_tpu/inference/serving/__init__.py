@@ -1,4 +1,5 @@
-"""TPU-native serving subsystem: continuous batching over a slot-recycled KV pool.
+"""TPU-native serving subsystem: continuous batching over a slot-recycled KV pool,
+behind a health-supervised multi-replica router.
 
 Layers (bottom-up):
 
@@ -7,22 +8,37 @@ Layers (bottom-up):
   updates throughout;
 - :mod:`executor` — :class:`ChunkedDecodeExecutor`: compiled fixed-shape decode
   chunks of K steps over the slot-batch (one compile per (slots, cap, chunk,
-  sampling) key), per-slot prefill bucketed by prompt length;
+  sampling) key), per-slot prefill bucketed by prompt length, optional per-chunk
+  watchdog deadline (:class:`ChunkTimeoutError`);
 - :mod:`scheduler` — :class:`ContinuousBatchingScheduler`: bounded request queue
   with admission control, backpressure (reject-with-retry-after), deadlines,
-  cancellation, and slot recycling between chunks;
+  cancellation, slot recycling between chunks, and whole-replica eviction
+  (``evict_all``) for the router's checkpointless retry;
+- :mod:`router` — :class:`Router`: N engine replicas behind one admission queue
+  with least-outstanding dispatch, session affinity, the
+  LIVE→SUSPECT→DEAD→RECOVERING health state machine, checkpointless request
+  retry and SIGTERM graceful drain;
+- :mod:`chaos` — scripted replica kills/stalls for the chaos soak harness;
 - :mod:`telemetry` — :class:`ServingTelemetry`: per-request TTFT/TPOT, queue
-  depth, slot occupancy and tokens/sec through ``MonitorMaster``.
+  depth, slot occupancy and tokens/sec through ``MonitorMaster``
+  (:class:`~.router.RouterTelemetry` adds per-replica health/retry/eviction).
 """
 
-from .executor import ChunkedDecodeExecutor
+from .chaos import ChaosEvent, ChaosSchedule, parse_chaos
+from .executor import ChunkedDecodeExecutor, ChunkTimeoutError
 from .kv_pool import SlotKVPool
+from .router import (EngineReplica, ReplicaDeadError, ReplicaState, Router,
+                     RouterConfig, RouterDrainingError, RouterRequest,
+                     RouterRequestState, RouterTelemetry)
 from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
                         RequestHandle, RequestState, ServingConfig)
 from .telemetry import ServingTelemetry
 
 __all__ = [
-    "ChunkedDecodeExecutor", "SlotKVPool", "ContinuousBatchingScheduler",
-    "QueueFullError", "RequestHandle", "RequestState", "ServingConfig",
-    "ServingTelemetry",
+    "ChunkedDecodeExecutor", "ChunkTimeoutError", "SlotKVPool",
+    "ContinuousBatchingScheduler", "QueueFullError", "RequestHandle",
+    "RequestState", "ServingConfig", "ServingTelemetry",
+    "Router", "RouterConfig", "RouterRequest", "RouterRequestState",
+    "RouterTelemetry", "EngineReplica", "ReplicaState", "ReplicaDeadError",
+    "RouterDrainingError", "ChaosEvent", "ChaosSchedule", "parse_chaos",
 ]

@@ -1,0 +1,120 @@
+"""Chaos schedule: scripted replica kills/stalls/revives against a Router.
+
+Spec grammar (the ``--chaos`` flag of ``deepspeed-serve`` and the loadgen):
+semicolon-separated events, each ``kind:key=val,...``:
+
+    kill:replica=1,at=0.5          # kill replica 1 at t=0.5s
+    kill:replica=1,when=busy       # kill replica 1 the moment it has in-flight
+                                   # work with >=1 generated token (guarantees a
+                                   # real mid-decode eviction, deterministically)
+    stall:replica=0,when=busy,s=0.6   # wedge replica 0's next chunk for 0.6s
+                                      # (the chunk watchdog turns this into a
+                                      # ChunkTimeoutError)
+    revive:replica=1,at=2.0        # bring a killed replica back (RECOVERING
+                                   # probe follows per the router state machine)
+
+Events fire at most once. ``at`` is seconds since :class:`ChaosSchedule` start;
+``when=busy`` fires on the first poll where the target replica has a running
+request. ``poll()`` is called from the driving loop (loadgen / serve).
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ...utils.logging import logger
+
+KINDS = ("kill", "stall", "revive")
+
+
+@dataclass
+class ChaosEvent:
+    kind: str                       # kill | stall | revive
+    replica: int
+    at: Optional[float] = None      # seconds after schedule start
+    when: Optional[str] = None      # "busy"
+    duration: float = 0.5           # stall seconds
+    fired: bool = False
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown chaos kind {self.kind!r} "
+                             f"(expected one of {KINDS})")
+        if self.at is None and self.when is None:
+            raise ValueError(f"chaos event {self.kind!r} needs at=<s> or "
+                             "when=busy")
+        if self.when is not None and self.when != "busy":
+            raise ValueError(f"unknown chaos trigger when={self.when!r}")
+
+
+def parse_chaos(spec: str) -> List[ChaosEvent]:
+    """Parse a ``--chaos`` spec string into events (see module docstring)."""
+    events = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        if ":" not in part:
+            raise ValueError(f"malformed chaos event {part!r} "
+                             "(expected kind:key=val,...)")
+        kind, _, rest = part.partition(":")
+        kv = {}
+        for item in filter(None, (i.strip() for i in rest.split(","))):
+            if "=" not in item:
+                raise ValueError(f"malformed chaos field {item!r} in {part!r}")
+            k, _, v = item.partition("=")
+            kv[k.strip()] = v.strip()
+        events.append(ChaosEvent(
+            kind=kind.strip(),
+            replica=int(kv.get("replica", 0)),
+            at=float(kv["at"]) if "at" in kv else None,
+            when=kv.get("when"),
+            duration=float(kv.get("s", kv.get("duration", 0.5)))))
+    return events
+
+
+@dataclass
+class ChaosSchedule:
+    """Applies parsed events against a router as their triggers come due."""
+
+    events: List[ChaosEvent]
+    t0: float = field(default_factory=time.monotonic)
+
+    def _due(self, ev: ChaosEvent, router, now: float) -> bool:
+        if ev.when == "busy":
+            # require a WARM replica (first chunk compiled and completed): the
+            # point of when=busy is a deterministic mid-decode hit, and a kill/
+            # stall landing inside the first compile is a cold-start test, not
+            # a mid-decode one
+            r = router.replicas[ev.replica]
+            return r.running > 0 and getattr(r.scheduler.executor,
+                                             "chunk_warm", True)
+        return now - self.t0 >= ev.at
+
+    def poll(self, router, now: Optional[float] = None) -> List[ChaosEvent]:
+        """Fire every due event once; returns the events applied this poll."""
+        now = time.monotonic() if now is None else now
+        applied = []
+        for ev in self.events:
+            if ev.replica >= len(router.replicas):
+                # a mistyped index must fail the run, not silently leave the
+                # soak fault-free ("a chaos run must never degrade to nothing")
+                raise ValueError(f"chaos event {ev.kind!r} targets replica "
+                                 f"{ev.replica} but the router has only "
+                                 f"{len(router.replicas)}")
+            if ev.fired or not self._due(ev, router, now):
+                continue
+            ev.fired = True
+            replica = router.replicas[ev.replica]
+            if ev.kind == "kill":
+                replica.kill()
+            elif ev.kind == "revive":
+                replica.revive()
+            elif ev.kind == "stall":
+                replica.scheduler.executor.stall_next(ev.duration)
+            logger.warning(f"[chaos] {ev.kind} replica {ev.replica}"
+                           + (f" ({ev.duration}s)" if ev.kind == "stall"
+                              else ""))
+            applied.append(ev)
+        return applied
+
+    @property
+    def exhausted(self) -> bool:
+        return all(ev.fired for ev in self.events)

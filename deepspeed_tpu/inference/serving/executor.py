@@ -15,8 +15,18 @@ decoding. Compile-key discipline:
 
 KV buffers are donated unconditionally (chunk in-place-updates the pool rows;
 jax 0.4.37 honours ``donate_argnums`` on CPU too — no backend guards).
+
+Watchdog: with ``chunk_deadline_s`` set, each chunk (dispatch + host fetch — the
+two places a hung compile or collective wedges) runs on a watchdog thread and a
+deadline overrun raises :class:`ChunkTimeoutError` instead of blocking the
+scheduler loop forever. The timed region declares the ``serving.chunk_compute``
+fault point, so a ``delay`` fault (or the :meth:`stall_next` chaos hook) models
+the hang deterministically. A timed-out chunk's pool buffers are unrecoverable —
+they were donated into the wedged dispatch — so the caller must ``reset_pool``
+(the scheduler's decode-failure path already does).
 """
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
@@ -26,8 +36,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.causal_lm import init_cache
+from ...utils.fault_injection import fault_point
 from ..decode_fns import build_decode_chunk, build_prefill, make_slot_select_fn
 from .kv_pool import SlotKVPool
+
+
+class ChunkTimeoutError(RuntimeError):
+    """A decode chunk exceeded its wall-clock deadline (hung compile/collective).
+
+    Deliberately NOT a retryable transient: the chunk's donated KV buffers are
+    lost inside the wedged dispatch, so the only safe recovery is evict + pool
+    rebuild (+ requeue on another replica, when a router is above)."""
+
+    def __init__(self, deadline_s: float):
+        super().__init__(f"decode chunk exceeded its {deadline_s:.3f}s deadline")
+        self.deadline_s = float(deadline_s)
 
 
 def prompt_buckets(max_prompt_len: int, smallest: int = 8) -> Tuple[int, ...]:
@@ -59,9 +82,14 @@ class ChunkedDecodeExecutor:
     def __init__(self, engine, slots: int, cap: int, chunk_size: int,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, max_prompt_len: Optional[int]
-                 = None, base_seed: int = 0):
+                 = None, base_seed: int = 0,
+                 chunk_deadline_s: Optional[float] = None,
+                 cold_chunk_grace_s: float = 120.0):
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+        if chunk_deadline_s is not None and chunk_deadline_s <= 0:
+            raise ValueError("chunk_deadline_s must be positive when set, got "
+                             f"{chunk_deadline_s}")
         self.engine = engine
         self.slots = int(slots)
         self.cap = int(cap)
@@ -77,6 +105,25 @@ class ChunkedDecodeExecutor:
                                dtype=engine.dtype)
         self._slot_select = make_slot_select_fn(*self.sampling)
         self._base_key = jax.random.PRNGKey(base_seed)
+        self.chunk_deadline_s = chunk_deadline_s
+        self.cold_chunk_grace_s = float(cold_chunk_grace_s)
+        self._warm_chunk = False        # first successful chunk marks warm
+        self._stall_next = 0.0
+
+    @property
+    def chunk_warm(self) -> bool:
+        """True once the chunk fn has completed at least once — the point from
+        which ``chunk_deadline_s`` is enforced at face value (the first chunk is
+        granted ``cold_chunk_grace_s`` to cover its XLA compile)."""
+        return self._warm_chunk
+
+    def stall_next(self, seconds: float) -> None:
+        """Chaos hook: make the next chunk stall ``seconds`` inside the timed
+        region — a deterministic stand-in for a hung compile/collective. With a
+        ``chunk_deadline_s`` armed the watchdog converts it into a
+        :class:`ChunkTimeoutError`; without one it wedges, which is the failure
+        mode the watchdog exists to remove."""
+        self._stall_next = float(seconds)
 
     def reset_pool(self) -> None:
         """Discard the pool (e.g. after a failed dispatch that may have consumed
@@ -152,21 +199,69 @@ class ChunkedDecodeExecutor:
                   remaining: np.ndarray, eos_ids: np.ndarray, seeds: np.ndarray,
                   steps: np.ndarray) -> ChunkResult:
         """One K-step compiled chunk over the slot-batch; pool caches are donated
-        in and rebound from the output. All other state is host numpy."""
+        in and rebound from the output. All other state is host numpy.
+
+        With ``chunk_deadline_s`` set, dispatch + host fetch run on a watchdog
+        thread; an overrun raises :class:`ChunkTimeoutError` and the pool is left
+        unusable (its buffers are inside the wedged dispatch) — callers recover
+        via ``reset_pool``.
+        """
         self.engine._activate()
         fn = self._chunk_fn()
+        # snapshot the cache binding on THIS thread: if the watchdog abandons a
+        # wedged chunk and the caller rebuilds the pool, the late-finishing
+        # thread must keep donating the OLD buffers, never the fresh pool's
+        caches_in = self.pool.caches
+        args = (self.engine.params,
+                jnp.asarray(toks, jnp.int32).reshape(-1, 1), caches_in,
+                jnp.asarray(lens, jnp.int32), jnp.asarray(active, bool),
+                jnp.asarray(remaining, jnp.int32), jnp.asarray(eos_ids, jnp.int32),
+                jnp.asarray(seeds, jnp.int32), jnp.asarray(steps, jnp.int32),
+                self._base_key)
         t0 = time.perf_counter()
-        out = fn(self.engine.params, jnp.asarray(toks, jnp.int32).reshape(-1, 1),
-                 self.pool.caches, jnp.asarray(lens, jnp.int32),
-                 jnp.asarray(active, bool), jnp.asarray(remaining, jnp.int32),
-                 jnp.asarray(eos_ids, jnp.int32), jnp.asarray(seeds, jnp.int32),
-                 jnp.asarray(steps, jnp.int32), self._base_key)
-        buf, toks_d, caches, lens_d, active_d, remaining_d, steps_d = out
+
+        def timed():
+            # the region a deadline must cover: injected stalls, compile +
+            # dispatch (hung compile), and host fetch (hung collective)
+            fault_point("serving.chunk_compute")
+            if self._stall_next > 0:
+                stall, self._stall_next = self._stall_next, 0.0
+                time.sleep(stall)
+            buf, toks_d, caches, lens_d, active_d, remaining_d, steps_d = \
+                fn(*args)
+            host = (np.asarray(buf), np.asarray(toks_d), np.asarray(lens_d),
+                    np.asarray(active_d), np.asarray(remaining_d),
+                    np.asarray(steps_d))
+            return host, caches
+
+        if self.chunk_deadline_s is None:
+            host, caches = timed()
+        else:
+            # the first chunk per executor pays its XLA compile inside the timed
+            # region — grant it the cold grace so a routine compile doesn't read
+            # as a wedged replica (a genuinely hung compile still trips)
+            deadline = (self.chunk_deadline_s if self._warm_chunk
+                        else max(self.chunk_deadline_s, self.cold_chunk_grace_s))
+            box = {}
+
+            def runner():
+                try:
+                    box["out"] = timed()
+                except BaseException as e:      # surfaced on the caller thread
+                    box["exc"] = e
+
+            th = threading.Thread(target=runner, daemon=True,
+                                  name="ds-serve-chunk-watchdog")
+            th.start()
+            th.join(deadline)
+            if th.is_alive():
+                raise ChunkTimeoutError(deadline)
+            if "exc" in box:
+                raise box["exc"]
+            host, caches = box["out"]
+        self._warm_chunk = True
         self.pool.caches = caches
-        res = ChunkResult(buf=np.asarray(buf), toks=np.asarray(toks_d),
-                          lens=np.asarray(lens_d), active=np.asarray(active_d),
-                          remaining=np.asarray(remaining_d),
-                          steps=np.asarray(steps_d),
-                          elapsed=0.0)
-        res.elapsed = time.perf_counter() - t0          # after host fetches
-        return res
+        buf, toks_d, lens_d, active_d, remaining_d, steps_d = host
+        return ChunkResult(buf=buf, toks=toks_d, lens=lens_d, active=active_d,
+                           remaining=remaining_d, steps=steps_d,
+                           elapsed=time.perf_counter() - t0)

@@ -1,0 +1,845 @@
+"""Multi-replica serving front door: health-supervised routing over N engines.
+
+The layer above :class:`ContinuousBatchingScheduler`: "millions of users" needs
+N engine replicas behind ONE bounded admission queue, and it needs replica death
+to be an eviction-and-retry event, not a request-loss event. Following the
+fail-fast discipline of large-scale continuous-batching serving systems (and the
+elasticity pillar on the training side), **requests — not checkpoints — are the
+unit of recovery on the inference path**:
+
+- **admission** — one bounded router queue; a full queue raises
+  :class:`~.scheduler.QueueFullError` with a ``retry_after`` hint (rejected,
+  never dropped); a draining router raises :class:`RouterDrainingError`;
+- **dispatch** — least-outstanding-slots across healthy replicas, with session
+  affinity (requests carrying the same ``session`` stick to one replica — the
+  hook prefix-cache locality hangs off) that yields the moment the pinned
+  replica leaves ``LIVE``;
+- **health** — each replica runs a state machine
+  ``LIVE → SUSPECT → DEAD → RECOVERING (→ LIVE)`` driven by three signals:
+  heartbeats (every successful pump step), per-chunk watchdog deadlines
+  (:class:`~.executor.ChunkTimeoutError` surfacing as request errors), and a
+  consecutive-failure circuit breaker. ``DEAD`` → half-open probe after
+  ``recover_after_s`` (one request; success closes the breaker);
+- **checkpointless retry** — a dead replica's in-flight requests are evicted
+  *with their generated-so-far prefixes* and re-enqueued as
+  ``prompt + prefix`` / remaining budget (bounded attempts, per-request replica
+  exclusion lists). Greedy retry is prefix-consistent: the final token stream is
+  bit-identical to an unkilled run;
+- **drain** — SIGTERM (``install_sigterm_drain``) stops admission, lets in-flight
+  chunks finish (steps are chunk-granular, so no chunk is ever abandoned
+  half-way), evicts what remains with prefixes and hands the queue off as
+  re-submittable specs.
+
+Replicas here are in-process (:class:`EngineReplica`: one engine + one
+scheduler each — separate meshes in multi-chip deployments), with death/stall
+simulated through ``kill()``/``stall_next`` and the fault registry; the
+``DS_TPU_FAULT_SPEC`` env contract (``utils.fault_injection``) carries the same
+seeded schedules into subprocess-hosted replicas, whose router-side view would
+be the streamed token prefixes this module already treats as the only
+recoverable state.
+
+Threading: like the scheduler, the router is single-threaded — drive ``step()``
+/ ``run()`` from one thread. ``RouterRequest.cancel`` and ``begin_drain`` only
+set flags and are safe from signal handlers / other threads.
+"""
+
+import itertools
+import signal
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Deque, Dict, List, Optional, Set
+
+import numpy as np
+
+from ...utils.fault_injection import fault_point, retry_with_backoff
+from ...utils.logging import logger
+from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
+                        RequestState, ServingConfig, validate_admission)
+
+
+class ReplicaState(Enum):
+    LIVE = "live"
+    SUSPECT = "suspect"          # missed heartbeats; no new dispatches
+    DEAD = "dead"                # evicted; circuit open
+    RECOVERING = "recovering"    # half-open: one probe request at a time
+
+    @property
+    def code(self) -> int:
+        """Stable numeric code for monitor streams."""
+        return {"live": 0, "suspect": 1, "dead": 2, "recovering": 3}[self.value]
+
+
+class RouterRequestState(Enum):
+    QUEUED = "queued"
+    DISPATCHED = "dispatched"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    EXPIRED = "expired"
+    FAILED = "failed"            # retry budget exhausted
+    HANDED_OFF = "handed_off"    # drained: returned to the caller as a spec
+
+
+class ReplicaDeadError(RuntimeError):
+    """Dispatch attempted against a replica that is no longer serving."""
+
+
+class RouterDrainingError(RuntimeError):
+    """The router is draining (SIGTERM): admission is closed."""
+
+    def __init__(self):
+        super().__init__("router is draining; admission closed")
+
+
+@dataclass
+class RouterConfig:
+    max_queue: int = 256                 # router admission bound
+    suspect_after_s: float = 2.0         # missed-heartbeat → SUSPECT
+    dead_after_s: float = 6.0            # missed-heartbeat → DEAD (evict)
+    recover_after_s: float = 10.0        # DEAD → RECOVERING probe window
+    breaker_threshold: int = 3           # consecutive failures → DEAD
+    max_attempts: int = 3                # dispatches per request (1 + retries)
+    dispatch_retries: int = 1            # retry_with_backoff budget per dispatch
+    retry_base_delay: float = 0.01
+    retry_after_s: float = 0.25          # backpressure hint
+    serving: ServingConfig = field(default_factory=ServingConfig)  # per replica
+
+
+@dataclass
+class RouterRequest:
+    """Caller's view of a routed request. ``tokens`` accumulates across retry
+    attempts; ``prompt`` stays the ORIGINAL prompt (retries re-prefill
+    ``prompt + tokens`` internally)."""
+    id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    deadline_s: Optional[float]
+    seed: int
+    session: Optional[str]
+    arrival: float
+    state: RouterRequestState = RouterRequestState.QUEUED
+    tokens: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None
+    attempts: int = 0                 # dispatches so far
+    retried: int = 0                  # re-enqueues after eviction/failure
+    evictions: int = 0
+    excluded: Set[int] = field(default_factory=set)   # replica exclusion list
+    replica_id: Optional[int] = None
+    inner: Optional[object] = None    # current attempt's RequestHandle
+    ttft: Optional[float] = None
+    tpot: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    _cancel: bool = False
+
+    def cancel(self) -> None:
+        self._cancel = True
+        if self.inner is not None:
+            self.inner.cancel()
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RouterRequestState.FINISHED,
+                              RouterRequestState.CANCELLED,
+                              RouterRequestState.EXPIRED,
+                              RouterRequestState.FAILED,
+                              RouterRequestState.HANDED_OFF)
+
+    def result(self) -> np.ndarray:
+        """All generated tokens across attempts — including the in-flight
+        attempt's live progress (partial if cancelled/evicted)."""
+        cur = list(self.tokens)
+        if self.inner is not None:
+            cur.extend(int(t) for t in self.inner.tokens)
+        return np.asarray(cur, dtype=np.int32)
+
+    def output_ids(self) -> np.ndarray:
+        return np.concatenate([self.prompt.astype(np.int32), self.result()])
+
+    @property
+    def remaining_budget(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    def handoff_spec(self) -> Dict:
+        """Re-submittable form for drain hand-off: the generated prefix is
+        folded into the prompt so any router can continue the request."""
+        return {"id": self.id,
+                "prompt": [int(t) for t in self.prompt] + list(self.tokens),
+                "prefix_len": len(self.tokens),
+                "max_new_tokens": self.remaining_budget,
+                "eos_token_id": self.eos_token_id,
+                "deadline_s": self.deadline_s, "seed": self.seed,
+                "session": self.session}
+
+
+@dataclass
+class ReplicaHealth:
+    state: ReplicaState = ReplicaState.LIVE
+    consecutive_failures: int = 0
+    died_at: Optional[float] = None
+    probe_request: Optional[int] = None   # RouterRequest.id of half-open probe
+
+
+class EngineReplica:
+    """In-process replica: one engine + one continuous-batching scheduler.
+
+    Health signals the router reads: ``last_heartbeat`` (advanced by every
+    successful :meth:`step`), slot/queue occupancy, and per-request outcomes.
+    ``kill()`` simulates abrupt replica death — heartbeats stop, dispatches
+    raise — and ``revive()`` brings the process back for the RECOVERING probe.
+    """
+
+    def __init__(self, replica_id: int, engine,
+                 serving_config: Optional[ServingConfig] = None):
+        self.id = int(replica_id)
+        self.engine = engine
+        self.scheduler = ContinuousBatchingScheduler(engine, serving_config)
+        self.last_heartbeat = time.monotonic()
+        # last time the router TRIED to pump this replica: heartbeat age is
+        # measured against this, not wall time — an idle router that slept
+        # between requests has no evidence of death, only a replica that fails
+        # to respond while being pumped does
+        self.last_pump_attempt = self.last_heartbeat
+        self._killed = False
+
+    # ------------------------------------------------------------------ chaos
+    def kill(self) -> None:
+        """Simulate abrupt death: no more heartbeats, no more work."""
+        self._killed = True
+
+    def revive(self) -> None:
+        """Bring the replica back, modeling a FRESH process: any scheduler
+        state from before the kill is discarded (the router already evicted
+        and requeued those requests — leaving them would resume zombie decode
+        of work now owned by other replicas)."""
+        self._killed = False
+        if self.scheduler.busy:
+            self.scheduler.evict_all(reason="revive")
+        self.last_heartbeat = time.monotonic()
+        self.last_pump_attempt = self.last_heartbeat
+
+    @property
+    def alive(self) -> bool:
+        return not self._killed
+
+    # ------------------------------------------------------------------- work
+    def step(self) -> bool:
+        """One scheduler step + heartbeat; returns True when the replica
+        responded (i.e. it is not killed).
+
+        The heartbeat is stamped AFTER the step completes, with the real clock:
+        a step that spends seconds inside a first-dispatch XLA compile must not
+        read as a flatline (the router sweeps health at step start, so a
+        start-of-step stamp would age by the whole compile)."""
+        if self._killed:
+            return False
+        self.scheduler.step()
+        self.last_heartbeat = time.monotonic()
+        return True
+
+    def submit(self, *args, **kwargs):
+        if self._killed:
+            raise ReplicaDeadError(f"replica {self.id} is dead")
+        return self.scheduler.submit(*args, **kwargs)
+
+    # ---------------------------------------------------------------- metrics
+    @property
+    def free_slots(self) -> int:
+        return self.scheduler.executor.pool.free_slots
+
+    @property
+    def queued(self) -> int:
+        return self.scheduler.queue_depth
+
+    @property
+    def running(self) -> int:
+        return len(self.scheduler.active_requests)
+
+    @property
+    def outstanding(self) -> int:
+        return self.running + self.queued
+
+    @property
+    def available(self) -> int:
+        """Slots this replica could start on right now (free minus already
+        queued-at-replica) — the router's least-outstanding-slots currency."""
+        return self.free_slots - self.queued
+
+
+class RouterTelemetry:
+    """Router-level metrics through MonitorMaster + aggregate snapshot.
+
+    Monitor tags: ``router/queue_depth``, ``router/retried_total``,
+    ``router/evicted_total``, ``router/completed_total``,
+    ``router/rejected_total``, ``router/replica{i}/health`` (state code),
+    ``router/replica{i}/outstanding``, ``router/drain_ms``, per-request
+    ``router/ttft_ms`` / ``router/tpot_ms``.
+    """
+
+    def __init__(self, monitor=None, n_replicas: int = 1):
+        self.monitor = monitor
+        self.n_replicas = n_replicas
+        self._tick = 0
+        self._finished_idx = 0
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.cancelled = 0
+        self.expired = 0
+        self.handed_off = 0
+        self.retried = 0
+        self.evicted = 0
+        self.dispatched: Dict[int, int] = {i: 0 for i in range(n_replicas)}
+        self.transitions: List = []       # (tick, replica, old, new)
+        self.ttfts: List[float] = []
+        self.tpots: List[float] = []
+        self.drain_s: Optional[float] = None
+
+    def _write(self, events):
+        if self.monitor is not None and getattr(self.monitor, "enabled", False):
+            self.monitor.write_events(events)
+
+    def on_step(self, queue_depth: int, replicas, health) -> None:
+        self._tick += 1
+        ev = [("router/queue_depth", float(queue_depth), self._tick),
+              ("router/retried_total", float(self.retried), self._tick),
+              ("router/evicted_total", float(self.evicted), self._tick),
+              ("router/completed_total", float(self.completed), self._tick),
+              ("router/rejected_total", float(self.rejected), self._tick)]
+        for r in replicas:
+            ev.append((f"router/replica{r.id}/health",
+                       float(health[r.id].state.code), self._tick))
+            ev.append((f"router/replica{r.id}/outstanding",
+                       float(r.outstanding), self._tick))
+        self._write(ev)
+
+    def on_transition(self, replica_id: int, old: ReplicaState,
+                      new: ReplicaState) -> None:
+        self.transitions.append((self._tick, replica_id, old, new))
+        self._write([(f"router/replica{replica_id}/health", float(new.code),
+                      self._tick)])
+
+    def on_dispatch(self, replica_id: int) -> None:
+        self.dispatched[replica_id] = self.dispatched.get(replica_id, 0) + 1
+
+    def on_rejected(self) -> None:
+        self.rejected += 1
+
+    def on_evicted(self, n: int = 1) -> None:
+        self.evicted += n
+
+    def on_retried(self) -> None:
+        self.retried += 1
+
+    def on_drain(self, seconds: float, handed_off: int) -> None:
+        self.drain_s = seconds
+        self.handed_off += handed_off
+        self._write([("router/drain_ms", seconds * 1e3, self._tick),
+                     ("router/handed_off_total", float(self.handed_off),
+                      self._tick)])
+
+    def on_finished(self, rr: RouterRequest) -> None:
+        st = rr.state
+        if st == RouterRequestState.CANCELLED:
+            self.cancelled += 1
+            return
+        if st == RouterRequestState.EXPIRED:
+            self.expired += 1
+            return
+        if st == RouterRequestState.FAILED:
+            self.failed += 1
+            return
+        self.completed += 1
+        self._finished_idx += 1
+        ev = []
+        if rr.ttft is not None:
+            self.ttfts.append(rr.ttft)
+            ev.append(("router/ttft_ms", rr.ttft * 1e3, self._finished_idx))
+        if rr.tpot is not None:
+            self.tpots.append(rr.tpot)
+            ev.append(("router/tpot_ms", rr.tpot * 1e3, self._finished_idx))
+        self._write(ev)
+
+    @staticmethod
+    def _pct(xs: List[float], q: float) -> Optional[float]:
+        return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+    def snapshot(self) -> Dict:
+        # "lost" is the no-silent-loss invariant: every admitted request must
+        # end completed, caller-cancelled, expired, or explicitly handed off.
+        # FAILED (retry budget exhausted) counts as lost — it was admitted and
+        # not served.
+        lost = self.submitted - self.completed - self.cancelled \
+            - self.expired - self.handed_off
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "rejected": self.rejected,
+            "cancelled": self.cancelled,
+            "expired": self.expired,
+            "handed_off": self.handed_off,
+            "retried": self.retried,
+            "evicted": self.evicted,
+            "lost": lost,
+            "dispatched": dict(self.dispatched),
+            "drain_ms": None if self.drain_s is None else self.drain_s * 1e3,
+            "ttft_ms_p50": self._pct([x * 1e3 for x in self.ttfts], 50),
+            "ttft_ms_p95": self._pct([x * 1e3 for x in self.ttfts], 95),
+            "ttft_ms_p99": self._pct([x * 1e3 for x in self.ttfts], 99),
+            "tpot_ms_p50": self._pct([x * 1e3 for x in self.tpots], 50),
+            "tokens_total": 0,  # filled by Router.snapshot with replica sums
+        }
+
+
+class Router:
+    """N :class:`EngineReplica`\\ s behind one bounded admission queue."""
+
+    def __init__(self, engines: List, config: Optional[RouterConfig] = None,
+                 monitor=None):
+        if not engines:
+            raise ValueError("router needs at least one engine replica")
+        self.config = cfg = config or RouterConfig()
+        self.replicas = [EngineReplica(i, e, cfg.serving)
+                         for i, e in enumerate(engines)]
+        self.cap = self.replicas[0].scheduler.cap
+        self.max_prompt_len = self.replicas[0].scheduler.executor.max_prompt_len
+        self.telemetry = RouterTelemetry(monitor, len(self.replicas))
+        self.health: Dict[int, ReplicaHealth] = {
+            r.id: ReplicaHealth() for r in self.replicas}
+        self.queue: Deque[RouterRequest] = deque()
+        self.requests: List[RouterRequest] = []       # every admitted request
+        self._dispatched: Dict[int, List[RouterRequest]] = {
+            r.id: [] for r in self.replicas}
+        self._affinity: Dict[str, int] = {}
+        self._ids = itertools.count()
+        self._draining = False
+        self._drain_started: Optional[float] = None
+        self._prev_sigterm = None
+
+    # ---------------------------------------------------------------- frontend
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None, seed: int = 0,
+               session: Optional[str] = None) -> RouterRequest:
+        """Admit a request into the router queue. Raises ``ValueError`` on
+        inadmissible shapes, :class:`QueueFullError` under backpressure, and
+        :class:`RouterDrainingError` once draining has begun."""
+        if self._draining:
+            raise RouterDrainingError()
+        prompt, max_new = validate_admission(
+            prompt, max_new_tokens, self.config.serving.default_max_new_tokens,
+            self.max_prompt_len, self.cap)
+        if len(self.queue) >= self.config.max_queue:
+            self.telemetry.on_rejected()
+            raise QueueFullError(self.config.retry_after_s)
+        rr = RouterRequest(id=next(self._ids), prompt=prompt,
+                           max_new_tokens=max_new, eos_token_id=eos_token_id,
+                           deadline_s=deadline_s, seed=int(seed),
+                           session=session, arrival=time.monotonic())
+        self.queue.append(rr)
+        self.requests.append(rr)
+        self.telemetry.submitted += 1
+        return rr
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.queue) or any(self._dispatched[r.id]
+                                       for r in self.replicas)
+
+    def replica_state(self, replica_id: int) -> ReplicaState:
+        return self.health[replica_id].state
+
+    # -------------------------------------------------------------------- loop
+    def step(self, now: Optional[float] = None) -> None:
+        """One router iteration: sweep local queue, run the health state
+        machine (evicting newly-DEAD replicas), dispatch, pump every non-DEAD
+        replica one scheduler step, then harvest finished/errored attempts.
+
+        ``now`` is injectable for deterministic state-machine tests (it drives
+        deadline expiry and health-age checks; heartbeats themselves are always
+        stamped with the real clock when a replica's step completes — rewind
+        ``replica.last_heartbeat`` to simulate a flatline)."""
+        now = time.monotonic() if now is None else now
+        self._sweep_queue(now)
+        self._health_sweep(now)
+        if not self._draining:
+            self._dispatch(now)
+        self._pump(now)
+        self._harvest(now)
+        self.telemetry.on_step(len(self.queue), self.replicas, self.health)
+
+    def run(self, max_steps: int = 100000) -> Dict:
+        """Drive ``step()`` until every admitted request reaches a terminal
+        state (or ``max_steps``); returns the telemetry snapshot."""
+        steps = 0
+        while self.busy and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.snapshot()
+
+    def snapshot(self) -> Dict:
+        snap = self.telemetry.snapshot()
+        snap["tokens_total"] = sum(
+            r.scheduler.telemetry.tokens_total for r in self.replicas)
+        snap["replica_health"] = {r.id: self.health[r.id].state.value
+                                  for r in self.replicas}
+        return snap
+
+    # ------------------------------------------------------------------- drain
+    def begin_drain(self) -> None:
+        """Close admission (signal-handler safe: flag only)."""
+        if not self._draining:
+            self._draining = True
+            self._drain_started = time.monotonic()
+            logger.info("[router] drain started: admission closed")
+
+    def install_sigterm_drain(self):
+        """Route SIGTERM to :meth:`begin_drain`; returns the previous handler
+        (re-install it with ``signal.signal`` to uninstall)."""
+        def _handler(signum, frame):
+            self.begin_drain()
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+        return self._prev_sigterm
+
+    def drain(self, mode: str = "handoff", max_steps: int = 100000
+              ) -> List[Dict]:
+        """Graceful drain: stop admitting, finish in-flight chunks, hand off.
+
+        - ``mode="handoff"`` (SIGTERM default): one more step so current chunks
+          complete (steps are chunk-granular — nothing is abandoned mid-chunk),
+          then every in-flight request is evicted with its prefix and returned,
+          together with the undispatched queue, as re-submittable specs
+          (:meth:`RouterRequest.handoff_spec`) for the next router.
+        - ``mode="complete"``: run dispatched requests to completion; hand off
+          only the undispatched queue.
+        """
+        if mode not in ("handoff", "complete"):
+            raise ValueError(f"unknown drain mode {mode!r}")
+        self.begin_drain()
+        t0 = time.monotonic()
+        if mode == "complete":
+            steps = 0
+            while any(self._dispatched[r.id] for r in self.replicas) \
+                    and steps < max_steps:
+                self.step()
+                steps += 1
+        else:
+            self.step()                      # finish the in-flight chunks
+        handed: List[RouterRequest] = []
+        for r in self.replicas:
+            if not self._dispatched[r.id]:
+                continue
+            if self.health[r.id].state != ReplicaState.DEAD:
+                r.scheduler.evict_all(reason="drain")
+            for rr in self._dispatched[r.id]:
+                self._absorb_prefix(rr)
+                handed.append(rr)
+            self._dispatched[r.id].clear()
+        while self.queue:
+            handed.append(self.queue.popleft())
+        now = time.monotonic()
+        specs = []
+        for rr in handed:
+            rr.state = RouterRequestState.HANDED_OFF
+            rr.finish_reason = "drain"
+            rr.finished_at = now
+            specs.append(rr.handoff_spec())
+        self.telemetry.on_drain(now - t0, len(specs))
+        logger.info(f"[router] drain complete in {(now - t0) * 1e3:.1f} ms: "
+                    f"{len(specs)} request(s) handed off")
+        return specs
+
+    # ------------------------------------------------------------------ sweeps
+    def _expired(self, rr: RouterRequest, now: float) -> bool:
+        return (rr.deadline_s is not None
+                and now - rr.arrival > rr.deadline_s)
+
+    def _sweep_queue(self, now: float) -> None:
+        kept: Deque[RouterRequest] = deque()
+        for rr in self.queue:
+            if rr._cancel:
+                self._finalize(rr, RouterRequestState.CANCELLED, "cancelled",
+                               now)
+            elif self._expired(rr, now):
+                self._finalize(rr, RouterRequestState.EXPIRED, "deadline", now)
+            else:
+                kept.append(rr)
+        self.queue = kept
+
+    # ------------------------------------------------------------------ health
+    def _health_sweep(self, now: float) -> None:
+        cfg = self.config
+        for r in self.replicas:
+            h = self.health[r.id]
+            if h.state in (ReplicaState.LIVE, ReplicaState.SUSPECT,
+                           ReplicaState.RECOVERING):
+                # RECOVERING replicas age too: a replica killed mid-probe must
+                # flatline back to DEAD (and release its probe request), not
+                # hold the probe hostage forever. Age is pump-relative: a
+                # router that idled (no pumps) learned nothing — only failing
+                # to respond WHILE pumped counts as a missed heartbeat.
+                age = max(0.0, r.last_pump_attempt - r.last_heartbeat)
+                if age > cfg.dead_after_s:
+                    self._mark_dead(r, now, f"missed heartbeats for {age:.2f}s")
+                elif age > cfg.suspect_after_s:
+                    if h.state == ReplicaState.LIVE:
+                        self._transition(r.id, ReplicaState.SUSPECT)
+                elif h.state == ReplicaState.SUSPECT:
+                    self._transition(r.id, ReplicaState.LIVE)   # recovered
+            elif h.state == ReplicaState.DEAD:
+                if r.alive and h.died_at is not None \
+                        and now - h.died_at >= cfg.recover_after_s:
+                    h.probe_request = None
+                    self._transition(r.id, ReplicaState.RECOVERING)
+
+    def _transition(self, replica_id: int, new: ReplicaState) -> None:
+        h = self.health[replica_id]
+        old, h.state = h.state, new
+        if old != new:
+            logger.info(f"[router] replica {replica_id}: {old.value} -> "
+                        f"{new.value}")
+            self.telemetry.on_transition(replica_id, old, new)
+
+    def _mark_dead(self, replica, now: float, why: str) -> None:
+        h = self.health[replica.id]
+        if h.state == ReplicaState.DEAD:
+            return
+        logger.warning(f"[router] replica {replica.id} declared DEAD ({why}); "
+                       f"evicting {len(self._dispatched[replica.id])} "
+                       "in-flight request(s)")
+        self._transition(replica.id, ReplicaState.DEAD)
+        h.died_at = now
+        h.probe_request = None
+        h.consecutive_failures = 0
+        # affinity must not keep steering sessions at a corpse
+        for sess in [s for s, rid in self._affinity.items()
+                     if rid == replica.id]:
+            del self._affinity[sess]
+        if replica.alive:
+            # circuit-breaker death: the process is responsive, release its
+            # slots/pool properly. (A killed replica's device state is gone
+            # with the process; the host-side prefixes below are all we need.)
+            replica.scheduler.evict_all(reason="replica-dead")
+        for rr in self._dispatched[replica.id]:
+            self._requeue(rr, replica.id, now, breaker=False)
+        self._dispatched[replica.id].clear()
+
+    def _health_failure(self, replica_id: int, now: float) -> None:
+        h = self.health[replica_id]
+        h.consecutive_failures += 1
+        if h.state == ReplicaState.RECOVERING:
+            # half-open probe failed: back to DEAD, restart the recovery clock
+            self._mark_dead(self._replica(replica_id), now, "probe failed")
+        elif h.consecutive_failures >= self.config.breaker_threshold:
+            self._mark_dead(self._replica(replica_id), now,
+                            f"circuit breaker: {h.consecutive_failures} "
+                            "consecutive failures")
+
+    def _health_success(self, replica_id: int) -> None:
+        h = self.health[replica_id]
+        h.consecutive_failures = 0
+        if h.state == ReplicaState.RECOVERING:
+            h.probe_request = None
+            self._transition(replica_id, ReplicaState.LIVE)  # breaker closes
+
+    def _replica(self, replica_id: int) -> EngineReplica:
+        return self.replicas[replica_id]
+
+    # ---------------------------------------------------------------- dispatch
+    def _usable(self, replica: EngineReplica, rr: RouterRequest) -> bool:
+        h = self.health[replica.id]
+        if h.state == ReplicaState.LIVE:
+            return replica.available > 0
+        if h.state == ReplicaState.RECOVERING:
+            return h.probe_request is None and replica.available > 0
+        return False
+
+    def _pick(self, rr: RouterRequest) -> Optional[EngineReplica]:
+        cands = [r for r in self.replicas if self._usable(r, rr)]
+        if not cands:
+            return None
+        non_excluded = [r for r in cands if r.id not in rr.excluded]
+        pool = non_excluded or cands       # all excluded → retry anywhere sane
+        if rr.session is not None:
+            pinned = self._affinity.get(rr.session)
+            for r in pool:
+                if r.id == pinned:
+                    return r
+        return min(pool, key=lambda r: (r.outstanding, r.id))
+
+    def _dispatch(self, now: float) -> None:
+        cfg = self.config
+        for rr in list(self.queue):
+            target = self._pick(rr)
+            if target is None:
+                continue                   # exclusions differ per request
+            deadline = None
+            if rr.deadline_s is not None:
+                deadline = rr.deadline_s - (now - rr.arrival)
+                if deadline <= 0:
+                    self.queue.remove(rr)
+                    self._finalize(rr, RouterRequestState.EXPIRED, "deadline",
+                                   now)
+                    continue
+            prompt = np.concatenate(
+                [rr.prompt, np.asarray(rr.tokens, np.int32)]) \
+                if rr.tokens else rr.prompt
+
+            def attempt(t=target, p=prompt, r=rr, d=deadline):
+                fault_point("serving.router.dispatch")
+                return t.submit(p, max_new_tokens=r.remaining_budget,
+                                eos_token_id=r.eos_token_id, deadline_s=d,
+                                seed=r.seed)
+
+            try:
+                inner = retry_with_backoff(attempt,
+                                           retries=cfg.dispatch_retries,
+                                           base_delay=cfg.retry_base_delay)
+            except QueueFullError:
+                continue                   # replica raced full; try next tick
+            except Exception as e:
+                logger.warning(f"[router] dispatch of request {rr.id} to "
+                               f"replica {target.id} failed: "
+                               f"{type(e).__name__}: {e}")
+                rr.excluded.add(target.id)
+                self._health_failure(target.id, now)
+                continue
+            self.queue.remove(rr)
+            rr.state = RouterRequestState.DISPATCHED
+            rr.attempts += 1
+            rr.replica_id = target.id
+            rr.inner = inner
+            if rr._cancel:                 # cancel landed between ticks
+                inner.cancel()
+            self._dispatched[target.id].append(rr)
+            if rr.session is not None:
+                self._affinity[rr.session] = target.id
+            h = self.health[target.id]
+            if h.state == ReplicaState.RECOVERING:
+                h.probe_request = rr.id
+            self.telemetry.on_dispatch(target.id)
+
+    # -------------------------------------------------------------------- pump
+    def _pump(self, now: float) -> None:
+        attempted = [r for r in self.replicas
+                     if self.health[r.id].state != ReplicaState.DEAD]
+        pumped = [r for r in attempted if r.step()]
+        # one shared post-pump stamp: the pump is serial, so a co-replica's slow
+        # step (first-dispatch compile, long chunk) must not age the heartbeats
+        # of replicas that already responded this round
+        t = time.monotonic()
+        for r in attempted:
+            r.last_pump_attempt = t
+        for r in pumped:
+            r.last_heartbeat = t
+
+    # ----------------------------------------------------------------- harvest
+    def _absorb_prefix(self, rr: RouterRequest) -> None:
+        """Fold the current attempt's tokens into the cross-attempt stream."""
+        if rr.inner is not None:
+            rr.tokens.extend(int(t) for t in rr.inner.tokens)
+            if rr.first_token_at is None and rr.inner.first_token_at is not None:
+                rr.first_token_at = rr.inner.first_token_at
+                rr.ttft = rr.first_token_at - rr.arrival
+            rr.inner = None
+
+    def _harvest(self, now: float) -> None:
+        for r in self.replicas:
+            if self.health[r.id].state == ReplicaState.DEAD:
+                continue                   # handled by _mark_dead eviction
+            still: List[RouterRequest] = []
+            failures = 0
+            h = self.health[r.id]
+            for rr in self._dispatched[r.id]:
+                inner = rr.inner
+                if inner is None or not inner.done:
+                    still.append(rr)
+                    continue
+                if inner.state == RequestState.FINISHED:
+                    self._finalize(rr, RouterRequestState.FINISHED,
+                                   inner.finish_reason, now)
+                    self._health_success(r.id)
+                elif inner.state == RequestState.EXPIRED:
+                    self._finalize(rr, RouterRequestState.EXPIRED,
+                                   "deadline", now)
+                    if h.probe_request == rr.id:
+                        h.probe_request = None
+                elif inner.state == RequestState.CANCELLED \
+                        and inner.finish_reason == "cancelled":
+                    self._finalize(rr, RouterRequestState.CANCELLED,
+                                   "cancelled", now)
+                    if h.probe_request == rr.id:
+                        h.probe_request = None
+                else:
+                    # replica-side failure (finish_reason "error") or eviction:
+                    # checkpointless retry with the generated-so-far prefix.
+                    # Breaker accounting is DEFERRED below — _mark_dead mutates
+                    # the very list this loop walks.
+                    failures += 1
+                    self._requeue(rr, r.id, now, breaker=False)
+            self._dispatched[r.id] = still
+            for _ in range(failures):
+                self._health_failure(r.id, now)
+
+    def _requeue(self, rr: RouterRequest, replica_id: int, now: float,
+                 breaker: bool) -> None:
+        self._absorb_prefix(rr)
+        rr.evictions += 1
+        rr.excluded.add(replica_id)
+        self.telemetry.on_evicted()
+        if breaker:
+            self._health_failure(replica_id, now)
+        if rr._cancel:
+            self._finalize(rr, RouterRequestState.CANCELLED, "cancelled", now)
+            return
+        if self._expired(rr, now):
+            self._finalize(rr, RouterRequestState.EXPIRED, "deadline", now)
+            return
+        if rr.remaining_budget < 1:
+            # the dying replica delivered the full budget but never finalized;
+            # everything generated is in hand — this is a completion
+            self._finalize(rr, RouterRequestState.FINISHED, "length", now)
+            return
+        if rr.attempts >= self.config.max_attempts:
+            logger.error(f"[router] request {rr.id}: retry budget exhausted "
+                         f"after {rr.attempts} attempt(s)")
+            self._finalize(rr, RouterRequestState.FAILED, "error", now)
+            return
+        if rr.prompt.size + len(rr.tokens) > self.max_prompt_len:
+            # retry needs prompt+prefix to re-prefill; with the default
+            # max_prompt_len (cap-1) this cannot trip, but a tighter configured
+            # bound can — fail loudly rather than mis-serve
+            logger.error(f"[router] request {rr.id}: prefix "
+                         f"{len(rr.tokens)} tokens no longer fits "
+                         f"max_prompt_len={self.max_prompt_len}; cannot retry")
+            self._finalize(rr, RouterRequestState.FAILED, "error", now)
+            return
+        rr.state = RouterRequestState.QUEUED
+        rr.replica_id = None
+        rr.retried += 1
+        self.telemetry.on_retried()
+        self.queue.appendleft(rr)          # retries go to the head: oldest first
+
+    # --------------------------------------------------------------- lifecycle
+    def _finalize(self, rr: RouterRequest, state: RouterRequestState,
+                  reason: Optional[str], now: float) -> None:
+        self._absorb_prefix(rr)
+        rr.state = state
+        rr.finish_reason = reason
+        rr.finished_at = now
+        if (rr.first_token_at is not None and len(rr.tokens) > 1
+                and now > rr.first_token_at):
+            rr.tpot = (now - rr.first_token_at) / (len(rr.tokens) - 1)
+        self.telemetry.on_finished(rr)

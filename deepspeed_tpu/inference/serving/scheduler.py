@@ -54,6 +54,7 @@ class RequestState(Enum):
     FINISHED = "finished"
     CANCELLED = "cancelled"
     EXPIRED = "expired"
+    EVICTED = "evicted"     # replica death/drain: partial tokens kept for retry
 
 
 class QueueFullError(RuntimeError):
@@ -81,6 +82,27 @@ class ServingConfig:
     transient_retries: int = 2          # retry_with_backoff budget per dispatch
     retry_base_delay: float = 0.02
     base_seed: int = 0
+    chunk_deadline_s: Optional[float] = None   # per-chunk watchdog (None = off)
+
+
+def validate_admission(prompt, max_new_tokens: Optional[int],
+                       default_max_new: int, max_prompt_len: int, cap: int):
+    """Shared admission contract (scheduler + router): normalize the prompt and
+    budget, raise ``ValueError`` for anything that could never fit. One owner —
+    the router's pre-check must never drift from what a replica will accept."""
+    prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+    max_new = int(default_max_new if max_new_tokens is None else max_new_tokens)
+    if prompt.size < 1:
+        raise ValueError("prompt must contain at least one token")
+    if max_new < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+    if prompt.size > max_prompt_len:
+        raise ValueError(f"prompt length {prompt.size} exceeds "
+                         f"max_prompt_len={max_prompt_len}")
+    if prompt.size + max_new > cap:
+        raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
+                         f"({max_new}) exceeds KV capacity {cap}")
+    return prompt, max_new
 
 
 @dataclass
@@ -109,7 +131,7 @@ class RequestHandle:
     @property
     def done(self) -> bool:
         return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
-                              RequestState.EXPIRED)
+                              RequestState.EXPIRED, RequestState.EVICTED)
 
     def result(self) -> np.ndarray:
         """Generated tokens (EOS included when emitted; partial if cancelled)."""
@@ -130,7 +152,8 @@ class ContinuousBatchingScheduler:
             engine, slots=cfg.slots, cap=cap, chunk_size=cfg.chunk_size,
             do_sample=cfg.do_sample, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p,
-            max_prompt_len=cfg.max_prompt_len, base_seed=cfg.base_seed)
+            max_prompt_len=cfg.max_prompt_len, base_seed=cfg.base_seed,
+            chunk_deadline_s=cfg.chunk_deadline_s)
         self.cap = cap
         self.telemetry = ServingTelemetry(monitor)
         self.queue: Deque[RequestHandle] = deque()
@@ -152,19 +175,9 @@ class ContinuousBatchingScheduler:
                ) -> RequestHandle:
         """Enqueue a request. Raises ``ValueError`` on inadmissible shapes and
         :class:`QueueFullError` (with ``retry_after``) under backpressure."""
-        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
-        max_new = int(self.config.default_max_new_tokens
-                      if max_new_tokens is None else max_new_tokens)
-        if prompt.size < 1:
-            raise ValueError("prompt must contain at least one token")
-        if max_new < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
-        if prompt.size > self.executor.max_prompt_len:
-            raise ValueError(f"prompt length {prompt.size} exceeds "
-                             f"max_prompt_len={self.executor.max_prompt_len}")
-        if prompt.size + max_new > self.cap:
-            raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
-                             f"({max_new}) exceeds KV capacity {self.cap}")
+        prompt, max_new = validate_admission(
+            prompt, max_new_tokens, self.config.default_max_new_tokens,
+            self.executor.max_prompt_len, self.cap)
         if len(self.queue) >= self.config.max_queue:
             self.telemetry.on_rejected()
             raise QueueFullError(self.config.retry_after_s)
@@ -208,6 +221,38 @@ class ContinuousBatchingScheduler:
             self.step()
             steps += 1
         return self.telemetry.snapshot()
+
+    # --------------------------------------------------------------- eviction
+    def evict_all(self, reason: str = "evicted") -> List[RequestHandle]:
+        """Evict every queued and in-flight request with its generated-so-far
+        prefix: each handle finalizes as ``EVICTED`` (tokens kept), the slot
+        tables are cleared and the KV pool rebuilt.
+
+        This is the checkpointless-retry hook the router relies on: an evicted
+        handle re-enqueues elsewhere as ``prompt + tokens`` with the remaining
+        budget, and greedy decode continues prefix-consistently — the request,
+        not a checkpoint, is the unit of recovery on the inference path.
+        """
+        now = time.monotonic()
+        out: List[RequestHandle] = []
+        for h in self.queue:
+            self._finalize(h, RequestState.EVICTED, reason, now)
+            out.append(h)
+        self.queue.clear()
+        for slot, h in enumerate(self._slot_req):
+            if h is None:
+                continue
+            self._finalize(h, RequestState.EVICTED, reason, now)
+            out.append(h)
+            self._slot_req[slot] = None
+        self._active[:] = False
+        self._remaining[:] = 0
+        self._steps[:] = 0
+        self._eos[:] = -1
+        # rebuild rather than per-slot zero-fill: on the death path the old
+        # buffers may be inside a failed/wedged dispatch and cannot be trusted
+        self.executor.reset_pool()
+        return out
 
     # ----------------------------------------------------------------- sweeps
     def _expired(self, handle: RequestHandle, now: float) -> bool:

@@ -1,17 +1,24 @@
 """``deepspeed-serve``: the serving-subsystem entrypoint.
 
-Two modes over the same scheduler:
+Two modes over the same frontend (a single scheduler, or — with
+``--replicas N`` — the health-supervised multi-replica :class:`Router`):
 
 - **stdin mode** (default): read one JSON request per line
   (``{"prompt": [ids...], "max_new_tokens": 16, "eos_token_id": null,
-  "deadline_s": null, "seed": 0}``), stream one JSON result per completed
-  request to stdout (tokens + TTFT/TPOT + finish reason), then a final summary
-  line. Backpressured submissions are retried after the scheduler's hint.
+  "deadline_s": null, "seed": 0, "session": null}``), stream one JSON result per
+  completed request to stdout (tokens + TTFT/TPOT + finish reason), then a final
+  summary line. Backpressured submissions are retried after the scheduler's hint.
 - **--selftest**: synthesize a small random-weight model and a burst of random
-  requests; exit 0 iff every request completes. The zero-infrastructure way to
-  prove the serving ring works on this host.
+  requests; exit 0 iff every request completes. With ``--replicas >= 2`` the
+  selftest is a kill-and-retry round trip: a replica is killed mid-decode and
+  the run passes only if every request still completes with greedy outputs
+  bit-identical to an unkilled run (checkpointless retry proven end-to-end).
 
-Metrics go to the jsonl monitor backend when ``--jsonl-metrics DIR`` is given.
+``--chaos "<spec>"`` schedules replica kills/stalls (see ``serving.chaos``), and
+a ``DS_TPU_FAULT_SPEC`` env (``utils.fault_injection.fault_env``) is armed at
+startup — the hook chaos tests use to inject deterministically into
+subprocess-hosted serve processes. Metrics go to the jsonl monitor backend when
+``--jsonl-metrics DIR`` is given.
 """
 
 import argparse
@@ -23,7 +30,7 @@ from typing import Optional
 import numpy as np
 
 
-def _build_engine(args):
+def _build_engine(args, params=None):
     import jax.numpy as jnp
 
     from ...models.causal_lm import gpt2_cfg, llama_cfg
@@ -36,10 +43,19 @@ def _build_engine(args):
                  [args.dtype])
     engine = InferenceEngine(cfg, DeepSpeedInferenceConfig(
         dtype=args.dtype, max_out_tokens=args.max_seq_len,
-        tensor_parallel={"tp_size": args.tp}))
+        tensor_parallel={"tp_size": args.tp}), params=params)
     if args.checkpoint:
         engine.load_checkpoint(args.checkpoint)
     return engine
+
+
+def _build_engines(args, n: int):
+    """N replica engines with SHARED weights (replica 0's params are reused —
+    bit-identical replicas, init cost paid once; params are never donated, so
+    sharing the buffers is safe)."""
+    first = _build_engine(args)
+    return [first] + [_build_engine(args, params=first.params)
+                      for _ in range(n - 1)]
 
 
 def _make_monitor(args) -> Optional[object]:
@@ -61,17 +77,25 @@ def _result_line(h) -> str:
     })
 
 
-def _serve_stdin(sched, out=sys.stdout, inp=None):
+def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None):
     """Streaming serve loop: requests are admitted as their lines arrive (a
     reader thread feeds a queue, so a client may keep the pipe open and read
     results before sending more) and each result is emitted the moment its
     request completes. A malformed or inadmissible line fails alone — an
-    ``{"error": ...}`` line is emitted and serving continues."""
+    ``{"error": ...}`` line is emitted and serving continues.
+
+    ``sched`` is any frontend with the scheduler protocol (``submit`` /
+    ``step`` / ``busy`` / ``telemetry``) — a single
+    :class:`ContinuousBatchingScheduler` or a multi-replica :class:`Router`
+    (router-only fields like ``session`` are forwarded when present).
+    ``chaos`` is an optional :class:`~.chaos.ChaosSchedule` polled every loop.
+    """
     import queue as _queue
     import threading
 
     from .scheduler import QueueFullError
     inp = inp if inp is not None else sys.stdin
+    is_router = hasattr(sched, "replicas")
     lines: "_queue.Queue" = _queue.Queue()
     _EOF = object()
 
@@ -84,6 +108,10 @@ def _serve_stdin(sched, out=sys.stdout, inp=None):
     handles, pending, eof = [], [], False
     not_before = 0.0
     while not eof or pending or sched.busy:
+        if is_router and sched.draining:
+            break                            # SIGTERM: graceful drain below
+        if chaos is not None:
+            chaos.poll(sched)
         while True:                          # drain whatever the reader has
             try:
                 line = lines.get_nowait()
@@ -97,12 +125,14 @@ def _serve_stdin(sched, out=sys.stdout, inp=None):
         while pending and time.monotonic() >= not_before:
             try:
                 req = json.loads(pending[0])
+                kwargs = dict(max_new_tokens=req.get("max_new_tokens"),
+                              eos_token_id=req.get("eos_token_id"),
+                              deadline_s=req.get("deadline_s"),
+                              seed=req.get("seed", 0))
+                if is_router:
+                    kwargs["session"] = req.get("session")
                 handles.append(sched.submit(
-                    np.asarray(req["prompt"], np.int32),
-                    max_new_tokens=req.get("max_new_tokens"),
-                    eos_token_id=req.get("eos_token_id"),
-                    deadline_s=req.get("deadline_s"),
-                    seed=req.get("seed", 0)))
+                    np.asarray(req["prompt"], np.int32), **kwargs))
                 pending.pop(0)
             except QueueFullError as e:      # backpressure: drain, then resubmit
                 not_before = time.monotonic() + e.retry_after
@@ -117,7 +147,18 @@ def _serve_stdin(sched, out=sys.stdout, inp=None):
         for h in [h for h in handles if h.done]:
             out.write(_result_line(h) + "\n")
             handles.remove(h)
-    return sched.telemetry.snapshot()
+    if is_router and sched.draining:
+        # graceful drain: finish in-flight chunks, then emit a hand-off spec
+        # per unfinished request (re-submittable on another router) and an
+        # error line per never-admitted client line — nothing silently dropped
+        for spec in sched.drain():
+            out.write(json.dumps({"handoff": spec}) + "\n")
+        for line in pending:
+            out.write(json.dumps({"error": "draining", "line": line[:200]})
+                      + "\n")
+        for h in handles:
+            out.write(_result_line(h) + "\n")
+    return (sched.snapshot() if is_router else sched.telemetry.snapshot())
 
 
 def _selftest(sched, n_requests: int, vocab: int, seed: int = 0):
@@ -139,6 +180,48 @@ def _selftest(sched, n_requests: int, vocab: int, seed: int = 0):
     return ok, sched.telemetry.snapshot()
 
 
+def _selftest_router(router, engines, n_requests: int, vocab: int,
+                     seed: int = 0):
+    """Kill-and-retry round trip: submit a burst of greedy requests, kill one
+    replica the moment it is mid-decode, and require (1) every request
+    completes, (2) at least one was evicted+retried, (3) every output is
+    bit-identical to the unkilled per-request ``generate`` reference."""
+    from .chaos import ChaosEvent, ChaosSchedule
+    from .scheduler import QueueFullError
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, vocab, size=int(rng.integers(4, 10))
+                          ).astype(np.int32),
+             int(rng.integers(8, 16))) for _ in range(n_requests)]
+    victim = len(router.replicas) - 1
+    chaos = ChaosSchedule([ChaosEvent(kind="kill", replica=victim,
+                                      when="busy")])
+    pending = list(reqs)
+    handles = []
+    while pending or router.busy:
+        chaos.poll(router)
+        while pending:
+            prompt, max_new = pending[0]
+            try:
+                handles.append(router.submit(prompt, max_new_tokens=max_new))
+                pending.pop(0)
+            except QueueFullError:
+                break
+        router.step()
+    snap = router.snapshot()
+    ok = all(h.state.value == "finished" for h in handles)
+    retried = sum(h.retried for h in handles)
+    parity = True
+    for h, (prompt, max_new) in zip(handles, reqs):
+        ref = engines[0].generate(prompt[None, :], max_new_tokens=max_new)
+        if not np.array_equal(h.result(), np.asarray(ref)[0, prompt.size:]):
+            parity = False
+    snap["kill_fired"] = chaos.exhausted
+    snap["retried_requests"] = retried
+    snap["parity_ok"] = parity
+    ok = ok and parity and snap["lost"] == 0 and chaos.exhausted and retried > 0
+    return ok, snap
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="deepspeed-serve", description=__doc__)
     ap.add_argument("--family", default="gpt2", choices=("gpt2", "llama"))
@@ -154,6 +237,13 @@ def main(argv=None) -> int:
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--chunk-size", type=int, default=8)
     ap.add_argument("--max-queue", type=int, default=32)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help=">=2 serves through the multi-replica router")
+    ap.add_argument("--chaos", default=None,
+                    help="chaos spec, e.g. 'kill:replica=1,at=0.5;"
+                         "stall:replica=0,when=busy,s=0.6' (see serving.chaos)")
+    ap.add_argument("--chunk-deadline", type=float, default=None,
+                    help="per-chunk watchdog deadline in seconds")
     ap.add_argument("--jsonl-metrics", default=None,
                     help="directory for the jsonl monitor backend")
     ap.add_argument("--selftest", action="store_true")
@@ -161,18 +251,48 @@ def main(argv=None) -> int:
                     help="selftest request count")
     args = ap.parse_args(argv)
 
+    # a seeded fault schedule may have been serialized into our environment by
+    # a parent chaos harness (utils.fault_injection.fault_env)
+    from ...utils.fault_injection import apply_fault_env
+    apply_fault_env()
+
     from .scheduler import ContinuousBatchingScheduler, ServingConfig
-    engine = _build_engine(args)
-    sched = ContinuousBatchingScheduler(
-        engine, ServingConfig(slots=args.slots, chunk_size=args.chunk_size,
-                              max_queue=args.max_queue,
-                              max_seq_len=args.max_seq_len),
-        monitor=_make_monitor(args))
-    if args.selftest:
-        ok, snap = _selftest(sched, args.requests, args.vocab_size)
-        print(json.dumps({"selftest_ok": ok, **snap}))
-        return 0 if ok else 1
-    snap = _serve_stdin(sched)
+    serving_cfg = ServingConfig(slots=args.slots, chunk_size=args.chunk_size,
+                                max_queue=args.max_queue,
+                                max_seq_len=args.max_seq_len,
+                                chunk_deadline_s=args.chunk_deadline)
+    monitor = _make_monitor(args)
+    chaos = None
+    if args.replicas > 1:
+        from .chaos import ChaosSchedule, parse_chaos
+        from .router import Router, RouterConfig
+        engines = _build_engines(args, args.replicas)
+        rcfg = RouterConfig(serving=serving_cfg, max_queue=args.max_queue)
+        if args.selftest:
+            # tight health thresholds: the kill-and-retry round trip should
+            # prove itself in ~a second, not wait out production timeouts
+            rcfg.suspect_after_s, rcfg.dead_after_s = 0.05, 0.15
+            rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
+        front = Router(engines, rcfg, monitor=monitor)
+        front.install_sigterm_drain()      # SIGTERM = graceful drain
+        if args.chaos:
+            chaos = ChaosSchedule(parse_chaos(args.chaos))
+        if args.selftest:
+            ok, snap = _selftest_router(front, engines, args.requests,
+                                        args.vocab_size)
+            print(json.dumps({"selftest_ok": ok, **snap}))
+            return 0 if ok else 1
+    else:
+        if args.chaos:
+            raise SystemExit("--chaos needs --replicas >= 2")
+        engine = _build_engine(args)
+        front = ContinuousBatchingScheduler(engine, serving_cfg,
+                                            monitor=monitor)
+        if args.selftest:
+            ok, snap = _selftest(front, args.requests, args.vocab_size)
+            print(json.dumps({"selftest_ok": ok, **snap}))
+            return 0 if ok else 1
+    snap = _serve_stdin(front, chaos=chaos)
     print(json.dumps(snap), file=sys.stderr)
     return 0
 

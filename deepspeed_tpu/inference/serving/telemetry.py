@@ -31,6 +31,7 @@ class ServingTelemetry:
         self.rejected = 0
         self.cancelled = 0
         self.expired = 0
+        self.evicted = 0
         self.decode_seconds = 0.0
         self._t_start = time.perf_counter()
 
@@ -65,6 +66,9 @@ class ServingTelemetry:
         if handle.state == RequestState.EXPIRED:
             self.expired += 1
             return
+        if handle.state == RequestState.EVICTED:
+            self.evicted += 1
+            return
         self.completed += 1
         self._finished_idx += 1
         events = []
@@ -91,6 +95,7 @@ class ServingTelemetry:
             "rejected": self.rejected,
             "cancelled": self.cancelled,
             "expired": self.expired,
+            "evicted": self.evicted,
             "tokens_total": self.tokens_total,
             "tokens_per_sec": (self.tokens_total / self.decode_seconds
                                if self.decode_seconds > 0 else 0.0),

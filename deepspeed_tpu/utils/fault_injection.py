@@ -22,11 +22,24 @@ test replays exactly. All registry state is process-local and reset by
 :func:`reset_faults` (tests) — production code never registers faults, so the
 hot-path cost is one dict lookup against an empty dict.
 
+Two extensions for multi-process chaos testing:
+
+- **env propagation** — a seeded fault schedule serializes into the
+  ``DS_TPU_FAULT_SPEC`` environment variable (:func:`fault_env`) and a spawned
+  replica arms it at startup with :func:`apply_fault_env` — so a chaos test can
+  inject deterministically into subprocess-hosted replicas whose registry is
+  otherwise unreachable;
+- **introspection** — :func:`list_fault_points` lists every fault point the
+  process has declared (sites seen by ``fault_point``), plus armed/fired counts,
+  so harnesses can discover injectable sites instead of hard-coding them.
+
 :func:`retry_with_backoff` is the shared retry policy for every I/O path that can
 see transient errors (checkpoint shard writes, manifest reads, NVMe copies):
 bounded attempts, exponential backoff, retry only on ``retryable`` exception types.
 """
 
+import builtins
+import json
 import os
 import random
 import signal
@@ -75,6 +88,7 @@ class FaultRegistry:
         self._faults: Dict[str, List[_ArmedFault]] = {}
         self._rng = random.Random(seed)
         self._fired: Dict[str, int] = {}
+        self._sites: set = set()        # every site ever declared via check()
 
     def reseed(self, seed: int):
         with self._lock:
@@ -99,6 +113,17 @@ class FaultRegistry:
             self._faults.clear()
             self._fired.clear()
             self._rng = random.Random(0)
+            # _sites is deliberately kept: declared fault points are a property
+            # of the code that ran, not of what a test armed
+
+    def sites(self) -> Dict[str, Dict[str, int]]:
+        """Introspection: every known fault point (declared by a ``fault_point``
+        call, armed, or fired) → ``{"armed": n_specs, "fired": n}``."""
+        with self._lock:
+            known = self._sites | set(self._faults) | set(self._fired)
+            return {s: {"armed": len(self._faults.get(s, ())),
+                        "fired": self._fired.get(s, 0)}
+                    for s in sorted(known)}
 
     def fired(self, site: Optional[str] = None) -> int:
         with self._lock:
@@ -109,6 +134,7 @@ class FaultRegistry:
     def check(self, site: str):
         """The fault point: decide (under the lock) whether an armed fault fires,
         then act outside the lock. No-op when nothing is armed at ``site``."""
+        self._sites.add(site)       # introspection (set.add: GIL-atomic, cheap)
         if not self._faults:        # fast path: injection entirely disabled
             return
         to_fire: Optional[FaultSpec] = None
@@ -190,6 +216,78 @@ def faults_fired(site: Optional[str] = None) -> int:
 
 def reset_faults():
     _REGISTRY.reset()
+
+
+def list_fault_points() -> Dict[str, Dict[str, int]]:
+    """Every fault point this process knows about (declared / armed / fired) →
+    ``{"armed": n, "fired": n}``. Harness discovery API: chaos specs can target
+    real sites instead of hard-coded strings."""
+    return _REGISTRY.sites()
+
+
+# --------------------------------------------------------------- env propagation
+#
+# The registry is process-local; chaos tests on subprocess-hosted replicas need
+# the parent's seeded fault schedule to survive the exec boundary. The contract:
+# the parent serializes (site, FaultSpec) pairs + a registry seed into
+# DS_TPU_FAULT_SPEC; every spawned entrypoint that wants deterministic injection
+# calls apply_fault_env() at startup (deepspeed-serve and the loadgen do).
+
+FAULT_SPEC_ENV = "DS_TPU_FAULT_SPEC"
+
+
+def _spec_to_dict(spec: FaultSpec) -> Dict:
+    return {"kind": spec.kind, "prob": spec.prob, "after_n": spec.after_n,
+            "max_faults": spec.max_faults, "exc_type": spec.exc_type.__name__,
+            "message": spec.message, "delay_s": spec.delay_s}
+
+
+def _spec_from_dict(d: Dict) -> FaultSpec:
+    exc = getattr(builtins, str(d.get("exc_type", "OSError")), None)
+    if not (isinstance(exc, type) and issubclass(exc, BaseException)):
+        exc = OSError        # only builtin exception types cross the boundary
+    return FaultSpec(kind=d.get("kind", "io_error"),
+                     prob=float(d.get("prob", 1.0)),
+                     after_n=int(d.get("after_n", 0)),
+                     max_faults=(None if d.get("max_faults") is None
+                                 else int(d["max_faults"])),
+                     exc_type=exc,
+                     message=str(d.get("message", "injected fault")),
+                     delay_s=float(d.get("delay_s", 0.05)))
+
+
+def serialize_faults(entries: List[Tuple[str, FaultSpec]], seed: int = 0) -> str:
+    """JSON form of a seeded fault schedule, suitable for ``DS_TPU_FAULT_SPEC``."""
+    return json.dumps({"seed": int(seed),
+                       "faults": [{"site": site, **_spec_to_dict(spec)}
+                                  for site, spec in entries]})
+
+
+def fault_env(entries: List[Tuple[str, FaultSpec]], seed: int = 0
+              ) -> Dict[str, str]:
+    """``{DS_TPU_FAULT_SPEC: <json>}`` — merge into a child's ``env``."""
+    return {FAULT_SPEC_ENV: serialize_faults(entries, seed)}
+
+
+def apply_fault_env(environ=None) -> int:
+    """Arm the fault schedule carried by ``DS_TPU_FAULT_SPEC`` (if any) into this
+    process's registry, reseeding its RNG with the schedule's seed. Returns the
+    number of faults armed (0 when the variable is unset). Malformed payloads
+    raise ``ValueError`` — a chaos run must never silently degrade to fault-free."""
+    payload = (environ if environ is not None else os.environ).get(FAULT_SPEC_ENV)
+    if not payload:
+        return 0
+    try:
+        data = json.loads(payload)
+        entries = [(str(f["site"]), _spec_from_dict(f)) for f in data["faults"]]
+    except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+        raise ValueError(f"malformed {FAULT_SPEC_ENV}: {e}") from e
+    _REGISTRY.reseed(int(data.get("seed", 0)))
+    for site, spec in entries:
+        _REGISTRY.arm(site, spec)
+    logger.info(f"[fault] armed {len(entries)} fault(s) from {FAULT_SPEC_ENV}: "
+                f"{[s for s, _ in entries]}")
+    return len(entries)
 
 
 def retry_with_backoff(fn: Callable, retries: int = 3, base_delay: float = 0.05,

@@ -32,6 +32,10 @@ def pytest_configure(config):
         "markers", "serving: continuous-batching serving lane (scheduler, "
         "KV slot pool, chunked decode, loadgen smoke) — tier-1 fast lane")
     config.addinivalue_line(
+        "markers", "serving_router: multi-replica router lane (health state "
+        "machine, checkpointless retry, drain, chaos soak smoke) — tier-1 "
+        "fast lane")
+    config.addinivalue_line(
         "markers", "comm_overlap: comm-compute overlap parity lane (chunked "
         "collective matmuls, quantized allreduce, bench --overlap smoke) — "
         "tier-1 fast lane")
@@ -53,7 +57,8 @@ def pytest_collection_modifyitems(config, items):
     def rank(it):
         if "test_fault_tolerance" in it.nodeid:
             return 0
-        if "inference/serving" in it.nodeid:
+        if "inference/serving" in it.nodeid \
+                or it.get_closest_marker("serving_router") is not None:
             return 1
         if it.get_closest_marker("comm_overlap") is not None:
             return 2

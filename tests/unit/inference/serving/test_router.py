@@ -1,0 +1,484 @@
+"""Multi-replica router tests: dispatch fairness, session affinity, the
+LIVE→SUSPECT→DEAD→RECOVERING health state machine, checkpointless retry
+(greedy prefix-consistency after a mid-decode kill), graceful SIGTERM drain,
+circuit-breaker reopen, the per-chunk watchdog, DS_TPU_FAULT_SPEC propagation,
+and the chaos soak smoke lane.
+
+Determinism notes: replica weights are bit-identical (shared params), greedy
+decode through any replica is bit-identical to per-request ``generate``, and a
+retried request re-prefilling ``prompt + prefix`` continues the same greedy
+stream — so every recovery test asserts exact token equality, not similarity.
+Health transitions are driven by rewinding ``replica.last_heartbeat`` (the
+documented flatline simulation) rather than wall-clock sleeps wherever possible.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.serving import (ChunkTimeoutError, QueueFullError,
+                                             ReplicaState, Router, RouterConfig,
+                                             RouterDrainingError,
+                                             RouterRequestState,
+                                             ContinuousBatchingScheduler,
+                                             ServingConfig, parse_chaos)
+from deepspeed_tpu.models.causal_lm import gpt2_cfg
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.serving_router
+
+TINY = dict(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+            dtype=jnp.float32)
+CAP = 48
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+
+@pytest.fixture(scope="module")
+def engines():
+    """Two replica engines with SHARED (bit-identical) weights."""
+    e0 = InferenceEngine(gpt2_cfg(**TINY), ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=CAP))
+    e1 = InferenceEngine(gpt2_cfg(**TINY), ds.inference.DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=CAP), params=e0.params)
+    return [e0, e1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fi.reset_faults()
+    yield
+    fi.reset_faults()
+
+
+def make_router(engines, monitor=None, **over):
+    serving = over.pop("serving", None) or ServingConfig(
+        slots=2, chunk_size=3, max_seq_len=CAP, retry_base_delay=0.001)
+    rcfg = RouterConfig(serving=serving, suspect_after_s=0.04,
+                        dead_after_s=0.12, recover_after_s=0.2,
+                        breaker_threshold=2, max_attempts=4,
+                        retry_base_delay=0.001)
+    for k, v in over.items():
+        setattr(rcfg, k, v)
+    return Router(engines, rcfg, monitor=monitor)
+
+
+def _prompts(seed=0, sizes=(8, 5, 3, 6)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, TINY["vocab_size"], size=s).astype(np.int32)
+            for s in sizes]
+
+
+def _ref(engines, prompt, max_new):
+    out = np.asarray(engines[0].generate(prompt[None, :],
+                                         max_new_tokens=max_new))
+    return out[0, prompt.size:]
+
+
+def _flatline(router, replica_id, seconds):
+    """Simulate `seconds` of missed heartbeats on a replica."""
+    router.replicas[replica_id].last_heartbeat = time.monotonic() - seconds
+
+
+# ---------------------------------------------------------------- dispatch
+def test_dispatch_fairness_least_outstanding(engines):
+    """4 concurrent requests over 2×2 slots spread 2/2 (least-outstanding)."""
+    router = make_router(engines)
+    ps = _prompts(0)
+    hs = [router.submit(ps[i], max_new_tokens=5) for i in range(4)]
+    router.step()
+    placement = [h.replica_id for h in hs]
+    assert placement == [0, 1, 0, 1]
+    assert all(h.state == RouterRequestState.DISPATCHED for h in hs)
+    router.run()
+    assert all(h.state == RouterRequestState.FINISHED for h in hs)
+    for h, p in zip(hs, ps):
+        np.testing.assert_array_equal(h.result(), _ref(engines, p, 5))
+    snap = router.snapshot()
+    assert snap["lost"] == 0
+    assert snap["dispatched"] == {0: 2, 1: 2}
+
+
+def test_session_affinity_sticks_and_yields_on_death(engines):
+    router = make_router(engines)
+    p0, p1, p2, _ = _prompts(1)
+    h_a = router.submit(p0, max_new_tokens=3, session="alice")
+    router.run()
+    pinned = h_a.replica_id
+    other = 1 - pinned
+    # load the pinned replica so least-outstanding alone would pick the other
+    h_busy = router.submit(p1, max_new_tokens=18)
+    # least-outstanding tie-break sends the no-session request to replica 0;
+    # make sure the busy one actually sits on the pinned replica
+    while h_busy.replica_id is None:
+        router.step()
+    if h_busy.replica_id != pinned:
+        h_b2 = router.submit(p1, max_new_tokens=18)
+        router.step()
+    h_a2 = router.submit(p2, max_new_tokens=3, session="alice")
+    router.step()
+    assert h_a2.replica_id == pinned          # affinity beats least-outstanding
+    router.run()
+    # kill the pinned replica: affinity must yield to a healthy one
+    router.replicas[pinned].kill()
+    _flatline(router, pinned, 1.0)
+    router.step()
+    assert router.replica_state(pinned) == ReplicaState.DEAD
+    h_a3 = router.submit(p2, max_new_tokens=3, session="alice")
+    router.run()
+    assert h_a3.replica_id == other
+    assert h_a3.state == RouterRequestState.FINISHED
+
+
+# ------------------------------------------------------------------ health
+def test_suspect_then_dead_on_missed_heartbeats(engines):
+    router = make_router(engines)
+    p0, p1, _, _ = _prompts(2)
+    h0 = router.submit(p0, max_new_tokens=24)
+    h1 = router.submit(p1, max_new_tokens=6)
+    router.step()
+    victim = h0.replica_id
+    survivor = 1 - victim
+    got_before = h0.result().size
+    assert got_before >= 1                    # prefill token already out
+    router.replicas[victim].kill()
+    _flatline(router, victim, 0.06)           # > suspect_after, < dead_after
+    router.step()
+    assert router.replica_state(victim) == ReplicaState.SUSPECT
+    assert h0.state == RouterRequestState.DISPATCHED   # not evicted yet
+    _flatline(router, victim, 0.2)            # > dead_after
+    router.step()
+    assert router.replica_state(victim) == ReplicaState.DEAD
+    # evicted with prefix, requeued, and completed on the survivor
+    router.run()
+    assert h0.state == RouterRequestState.FINISHED
+    assert h0.retried == 1 and h0.evictions == 1
+    assert h0.replica_id == survivor
+    np.testing.assert_array_equal(h0.result(), _ref(engines, p0, 24))
+    assert h1.state == RouterRequestState.FINISHED
+    snap = router.snapshot()
+    assert snap["lost"] == 0 and snap["evicted"] >= 1 and snap["retried"] >= 1
+    seen = [(t[1], t[2].value, t[3].value) for t in router.telemetry.transitions]
+    assert (victim, "live", "suspect") in seen
+    assert (victim, "suspect", "dead") in seen
+
+
+def test_mid_decode_kill_retry_is_prefix_consistent(engines):
+    """The acceptance core: kill a replica mid-decode; the evicted request's
+    final output is bit-identical to an unkilled greedy run."""
+    router = make_router(engines)
+    p0, p1, _, _ = _prompts(3)
+    h0 = router.submit(p0, max_new_tokens=20)
+    h1 = router.submit(p1, max_new_tokens=20)
+    # step until both are mid-decode with several tokens out
+    for _ in range(50):
+        router.step()
+        if min(h0.result().size, h1.result().size) >= 4:
+            break
+    assert min(h0.result().size, h1.result().size) >= 4
+    victim = h0.replica_id
+    router.replicas[victim].kill()
+    _flatline(router, victim, 1.0)
+    router.run()
+    assert h0.state == h1.state == RouterRequestState.FINISHED
+    killed = h0 if h0.replica_id != victim or h0.retried else h1
+    assert (h0.retried + h1.retried) >= 1
+    np.testing.assert_array_equal(h0.result(), _ref(engines, p0, 20))
+    np.testing.assert_array_equal(h1.result(), _ref(engines, p1, 20))
+    assert router.snapshot()["lost"] == 0
+    assert killed.ttft is not None
+
+
+def test_circuit_breaker_opens_then_reopens(engines):
+    """Consecutive request failures open the breaker (DEAD without any
+    heartbeat loss); after recover_after_s a half-open probe closes it again."""
+    serving = ServingConfig(slots=2, chunk_size=3, max_seq_len=CAP,
+                            transient_retries=0, retry_base_delay=0.001)
+    router = make_router([engines[0]], serving=serving)
+    p0 = _prompts(4, sizes=(5,))[0]
+    with fi.inject("serving.prefill",
+                   fi.FaultSpec(kind="io_error", max_faults=2)):
+        h = router.submit(p0, max_new_tokens=4)
+        router.step()                         # attempt 1 fails
+        assert router.replica_state(0) == ReplicaState.LIVE
+        assert router.health[0].consecutive_failures == 1
+        router.step()                         # attempt 2 fails → breaker opens
+        assert router.replica_state(0) == ReplicaState.DEAD
+        assert h.state == RouterRequestState.QUEUED and h.retried == 2
+        time.sleep(0.25)                      # > recover_after_s
+        router.step()
+        # the half-open probe may complete within this very step (warm
+        # compiles, tiny budget) — RECOVERING is proven via the transition log
+        assert router.replica_state(0) in (ReplicaState.RECOVERING,
+                                           ReplicaState.LIVE)
+        router.run()                          # probe succeeds → breaker closes
+    assert router.replica_state(0) == ReplicaState.LIVE
+    assert h.state == RouterRequestState.FINISHED
+    np.testing.assert_array_equal(h.result(), _ref(engines, p0, 4))
+    seen = [(t[2].value, t[3].value) for t in router.telemetry.transitions]
+    assert ("live", "dead") in seen           # breaker: no SUSPECT stop-over
+    assert ("dead", "recovering") in seen and ("recovering", "live") in seen
+
+
+# ------------------------------------------------------------------- drain
+def test_graceful_drain_on_sigterm(engines):
+    router = make_router(engines)
+    prev = router.install_sigterm_drain()
+    try:
+        ps = _prompts(5, sizes=(6, 4, 5, 3))
+        hs = [router.submit(p, max_new_tokens=12) for p in ps]
+        router.step()                         # some running, maybe some queued
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.01)                      # let the handler run
+        assert router.draining
+        with pytest.raises(RouterDrainingError):
+            router.submit(ps[0], max_new_tokens=2)
+        specs = router.drain()
+        assert router.telemetry.drain_s is not None
+        assert all(h.state == RouterRequestState.HANDED_OFF for h in hs)
+        assert len(specs) == len(hs)
+        assert router.snapshot()["lost"] == 0
+        # hand the queue off to a fresh router: prefix + continuation must be
+        # bit-identical to an uninterrupted greedy run of the original request
+        # (specs are in dispatch order, not submission order — join on id)
+        router2 = make_router(engines)
+        hs2 = {s["id"]: router2.submit(np.asarray(s["prompt"], np.int32),
+                                       max_new_tokens=s["max_new_tokens"])
+               for s in specs}
+        router2.run()
+        for h, p in zip(hs, ps):
+            h2 = hs2[h.id]
+            assert h2.state == RouterRequestState.FINISHED
+            full = np.concatenate([h.result(), h2.result()])
+            np.testing.assert_array_equal(full, _ref(engines, p, 12))
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------- watchdog
+def test_chunk_watchdog_timeout_evicts_and_recovers(engines):
+    """Satellite: an injected chunk stall raises ChunkTimeoutError through the
+    serving.decode_chunk dispatch path instead of wedging the loop; the
+    scheduler fails the in-flight work, rebuilds the pool and keeps serving."""
+    sched = ContinuousBatchingScheduler(engines[0], ServingConfig(
+        slots=2, chunk_size=3, max_seq_len=CAP, chunk_deadline_s=0.15,
+        transient_retries=0, retry_base_delay=0.001))
+    p0 = _prompts(6, sizes=(5,))[0]
+    h_warm = sched.submit(p0, max_new_tokens=3)   # pays the cold compile
+    sched.run()
+    assert h_warm.state.value == "finished"
+    assert sched.executor.chunk_warm
+    with fi.inject("serving.chunk_compute",
+                   fi.FaultSpec(kind="delay", delay_s=0.6, max_faults=1)):
+        h = sched.submit(p0, max_new_tokens=8)
+        sched.run()
+    assert h.state.value == "cancelled" and h.finish_reason == "error"
+    assert sched.executor.pool.free_slots == 2     # pool rebuilt
+    h_ok = sched.submit(p0, max_new_tokens=4)
+    sched.run()
+    assert h_ok.state.value == "finished"
+    np.testing.assert_array_equal(h_ok.result(), _ref(engines, p0, 4))
+
+
+def test_chunk_watchdog_raises_chunk_timeout_error(engines):
+    """Executor-level: the stall hook trips the deadline as ChunkTimeoutError."""
+    sched = ContinuousBatchingScheduler(engines[0], ServingConfig(
+        slots=1, chunk_size=2, max_seq_len=CAP, chunk_deadline_s=0.1))
+    p0 = _prompts(7, sizes=(4,))[0]
+    h = sched.submit(p0, max_new_tokens=2)
+    sched.run()                                    # warm
+    assert h.state.value == "finished"
+    ex = sched.executor
+    slot = ex.pool.acquire()
+    tok0, _ = ex.prefill_into_slot(slot, p0, 0)
+    ex.stall_next(0.5)
+    with pytest.raises(ChunkTimeoutError):
+        ex.run_chunk(np.array([tok0]), np.array([p0.size]), np.array([True]),
+                     np.array([4]), np.array([-1]), np.array([0]),
+                     np.array([1]))
+    ex.reset_pool()                                # buffers are unrecoverable
+
+
+# --------------------------------------------------------------- fault env
+def test_fault_env_roundtrip_and_introspection():
+    entries = [("demo.site", fi.FaultSpec(kind="io_error", max_faults=1,
+                                          message="boom")),
+               ("demo.delay", fi.FaultSpec(kind="delay", delay_s=0.01))]
+    env = fi.fault_env(entries, seed=7)
+    assert fi.FAULT_SPEC_ENV in env
+    armed = fi.apply_fault_env(env)
+    assert armed == 2
+    points = fi.list_fault_points()
+    assert points["demo.site"]["armed"] == 1
+    with pytest.raises(OSError, match="boom"):
+        fi.fault_point("demo.site")
+    fi.fault_point("demo.site")                    # max_faults=1: now free
+    assert fi.list_fault_points()["demo.site"]["fired"] == 1
+    # declared-but-unarmed sites are discoverable too
+    fi.fault_point("demo.unarmed")
+    assert fi.list_fault_points()["demo.unarmed"] == {"armed": 0, "fired": 0}
+    with pytest.raises(ValueError):
+        fi.apply_fault_env({fi.FAULT_SPEC_ENV: "not json"})
+
+
+def test_fault_env_propagates_into_subprocess():
+    """The chaos contract: a seeded schedule serialized by the parent arms
+    deterministically inside a spawned process."""
+    env = dict(os.environ)
+    env.update(fi.fault_env(
+        [("child.site", fi.FaultSpec(kind="io_error", max_faults=1,
+                                     message="from-parent"))], seed=3))
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "from deepspeed_tpu.utils import fault_injection as fi\n"
+        "assert fi.apply_fault_env() == 1\n"
+        "try:\n"
+        "    fi.fault_point('child.site')\n"
+        "    raise SystemExit(2)\n"
+        "except OSError as e:\n"
+        "    assert 'from-parent' in str(e), e\n"
+        "fi.fault_point('child.site')\n"
+        "assert fi.list_fault_points()['child.site']['fired'] == 1\n"
+        "print('FAULT_ENV_OK')\n")
+    res = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "FAULT_ENV_OK" in res.stdout
+
+
+# ------------------------------------------------------------- chaos smoke
+def test_chaos_soak_smoke(engines, tmp_path, capsys):
+    """The acceptance rig: ≥2 replicas under Poisson load with a scheduled
+    mid-run kill + one injected chunk stall — every admitted request completes
+    (lost == 0), evicted requests are bit-identical to unkilled greedy runs,
+    and per-replica health/retry/eviction metrics land in the monitor stream."""
+    spec = importlib.util.spec_from_file_location(
+        "serving_loadgen", os.path.join(REPO, "benchmarks", "serving",
+                                        "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    rc = loadgen.main([
+        "--smoke", "--replicas", "2",
+        "--chaos", "kill:replica=1,when=busy;stall:replica=0,when=busy,s=0.8",
+        "--jsonl-metrics", str(tmp_path)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    d = out["detail"]
+    assert d["all_finished"] and d["lost"] == 0
+    assert d["evicted"] >= 1 and d["retried"] >= 1
+    assert d["parity_checked"] >= 1 and d["parity_ok"]
+    tags = set()
+    for line in open(os.path.join(str(tmp_path), "loadgen.jsonl")):
+        tags.add(json.loads(line)["tag"])
+    assert {"router/replica0/health", "router/replica1/health",
+            "router/retried_total", "router/evicted_total",
+            "router/queue_depth"} <= tags
+
+
+def test_idle_gap_does_not_false_kill(engines):
+    """Heartbeat age is pump-relative: a router that slept between requests
+    (stdin server idle) must not declare un-pumped replicas dead."""
+    router = make_router(engines)
+    p = _prompts(9, sizes=(4,))[0]
+    h = router.submit(p, max_new_tokens=2)
+    router.run()
+    assert h.state == RouterRequestState.FINISHED
+    # simulate a long idle gap: both stamps age together (no pump attempts)
+    for r in router.replicas:
+        r.last_heartbeat -= 30.0
+        r.last_pump_attempt -= 30.0
+    h2 = router.submit(p, max_new_tokens=2)
+    router.run()
+    assert h2.state == RouterRequestState.FINISHED and h2.retried == 0
+    assert router.replica_state(0) == ReplicaState.LIVE
+    assert router.replica_state(1) == ReplicaState.LIVE
+
+
+def test_revive_resets_scheduler_state(engines):
+    """A revived replica models a fresh process: the pre-kill scheduler state
+    is discarded, not resumed as zombie decode of already-retried work."""
+    router = make_router(engines)
+    p = _prompts(10, sizes=(5,))[0]
+    h = router.submit(p, max_new_tokens=16)
+    router.step()
+    victim = h.replica_id
+    router.replicas[victim].kill()
+    _flatline(router, victim, 1.0)
+    router.run()                              # evicted, retried, finished
+    assert h.state == RouterRequestState.FINISHED and h.retried == 1
+    vr = router.replicas[victim]
+    assert vr.scheduler.busy                  # zombie state still parked there
+    vr.revive()
+    assert not vr.scheduler.busy              # discarded on revive
+    assert vr.free_slots == 2
+    time.sleep(0.25)                          # > recover_after_s
+    router.step()                             # DEAD → RECOVERING
+    h2 = router.submit(p, max_new_tokens=3)
+    router.run()
+    assert h2.state == RouterRequestState.FINISHED
+    assert router.replica_state(victim) == ReplicaState.LIVE
+
+
+def test_serve_stdin_drains_on_sigterm_with_handoff(engines):
+    """deepspeed-serve stdin loop under SIGTERM: finishes nothing silently —
+    unfinished requests come back as hand-off specs, never a livelock."""
+    import io
+
+    from deepspeed_tpu.inference.serving import server as srv
+    router = make_router(engines)
+    p = _prompts(11, sizes=(4,))[0]
+    # park work on the router, then begin draining before the stdin loop runs
+    hs = [router.submit(p, max_new_tokens=10) for _ in range(3)]
+    router.begin_drain()
+    out = io.StringIO()
+    snap = srv._serve_stdin(router, out=out, inp=io.StringIO(""))
+    lines = [json.loads(x) for x in out.getvalue().strip().splitlines()]
+    handoffs = [ln for ln in lines if "handoff" in ln]
+    assert len(handoffs) == 3
+    assert all(h.state == RouterRequestState.HANDED_OFF for h in hs)
+    assert snap["lost"] == 0 and snap["handed_off"] == 3
+
+
+def test_chaos_rejects_out_of_range_replica(engines):
+    from deepspeed_tpu.inference.serving import ChaosSchedule
+    router = make_router(engines)
+    sched = ChaosSchedule(parse_chaos("kill:replica=5,at=0.0"))
+    with pytest.raises(ValueError, match="replica 5"):
+        sched.poll(router)
+
+
+# ------------------------------------------------------------------- misc
+def test_router_backpressure_and_validation(engines):
+    router = make_router(engines, max_queue=1)
+    p = _prompts(8, sizes=(4,))[0]
+    with pytest.raises(ValueError):
+        router.submit(np.arange(CAP, dtype=np.int32) % 8)   # prompt too long
+    with pytest.raises(ValueError):
+        router.submit(p, max_new_tokens=0)
+    router.submit(p, max_new_tokens=2)
+    with pytest.raises(QueueFullError) as ei:
+        router.submit(p, max_new_tokens=2)
+    assert ei.value.retry_after > 0
+    assert router.snapshot()["rejected"] == 1
+    router.run()
+
+
+def test_parse_chaos_rejects_malformed():
+    assert len(parse_chaos("kill:replica=1,at=0.5;stall:replica=0,"
+                           "when=busy,s=0.2")) == 2
+    with pytest.raises(ValueError):
+        parse_chaos("explode:replica=0,at=1")
+    with pytest.raises(ValueError):
+        parse_chaos("kill:replica=0")          # no trigger
+    with pytest.raises(ValueError):
+        parse_chaos("kill:replica=0,when=quiet")
