@@ -1,7 +1,7 @@
-"""Serving load generator + chaos soak harness: Poisson arrivals through the
-continuous-batching scheduler — or, with ``--replicas N``, through the
-multi-replica router under scheduled fault injection — BENCH-style JSON on
-stdout.
+"""Serving load generator + chaos soak harness: Poisson (or Markov-modulated
+bursty) arrivals through the continuous-batching scheduler — or, with
+``--replicas N``, through the multi-replica router under scheduled fault
+injection — BENCH-style JSON on stdout.
 
 Drives the real frontend (admission, backpressure, slot recycling, and in
 router mode health supervision + checkpointless retry) with open-loop traffic:
@@ -12,18 +12,35 @@ backoff (``retry_after * (0.5 + U[0,1))``, per request — no head-of-line
 thundering herd) and resubmits. Emitted throughput therefore includes
 admission-control effects, not just raw decode speed.
 
+Shared-prefix traces (``--prefix-pool N --prefix-len L``): every prompt is one
+of N pool "system prompts" of L tokens plus a short random tail — real serving
+traffic's shape, and the acceptance harness for the radix prefix KV cache
+(``--prefix-cache``). The BENCH JSON then splits TTFT into **hit vs miss**
+percentiles (a request is a hit when its first token came from a
+restored-prefix suffix prefill, ``handle.prefix_hit_tokens > 0``) and reports
+the measured hit-rate plus the engine-side ``prefix_cache_report``.
+
+Bursty mode (``--arrival bursty``): a two-state Markov-modulated Poisson
+process — exponential ON/OFF holding times (``--burst-on-s`` / ``--burst-off-s``
+means), arrivals only during ON at ``rate * --burst-mult`` — the arrival shape
+that makes prefill spikes (and the prefix cache's absorption of them) visible.
+
 Chaos soak (``--replicas >= 2 --chaos "<spec>"``, grammar in
 ``inference.serving.chaos``): scheduled replica kills/stalls run against the
-router mid-load; the BENCH JSON then carries the no-loss accounting —
-``retried`` / ``evicted`` / ``lost`` (the run fails unless ``lost == 0``) — and,
-for greedy runs, ``parity_ok``: every evicted-and-retried request's final output
-is re-checked bit-identical against an unkilled per-request ``generate``.
+router mid-load — including ``kill:replica=i,when=restore``, which lands the
+kill between a prefix-slab restore and its suffix prefill; the BENCH JSON then
+carries the no-loss accounting — ``retried`` / ``evicted`` / ``lost`` (the run
+fails unless ``lost == 0``) — and, for greedy runs, ``parity_ok``: every
+evicted-and-retried request's final output is re-checked bit-identical against
+an unkilled per-request ``generate``. ``--verify-parity`` extends that re-check
+to EVERY request (the prefix-cache bit-exactness acceptance gate).
 
 ``--smoke`` shrinks everything (tiny model, few requests) to a seconds-long run —
 the mode the serving tests execute in-process.
 
 Output: one JSON object, ``{"metric": "serving_tokens_per_sec", "value": ...,
-"unit": "tok/s", ...}`` with the telemetry snapshot nested under ``"detail"``.
+"unit": "tok/s", ...}`` with the telemetry snapshot nested under ``"detail"``
+(also written to ``--out FILE`` when given, e.g. ``BENCH_PREFIX_r09.json``).
 """
 
 import argparse
@@ -54,19 +71,66 @@ def build_engine(args, params=None):
         dtype=args.dtype, max_out_tokens=args.max_seq_len), params=params)
 
 
+def make_prompts(args, rng):
+    """Random prompts; with ``--prefix-pool`` each is pool-prefix + random tail
+    (the shared-system-prompt trace shape)."""
+    n = args.requests
+    tails = [rng.integers(0, args.vocab_size,
+                          size=int(rng.integers(args.min_prompt,
+                                                args.max_prompt + 1))
+                          ).astype(np.int32) for _ in range(n)]
+    if not args.prefix_pool:
+        return tails, [None] * n
+    pool = [rng.integers(0, args.vocab_size, size=args.prefix_len
+                         ).astype(np.int32) for _ in range(args.prefix_pool)]
+    picks = rng.integers(0, args.prefix_pool, size=n)
+    prompts = [np.concatenate([pool[int(p)], t])
+               for p, t in zip(picks, tails)]
+    # session = pool id: the router's affinity then concentrates each shared
+    # prefix on one replica — the locality hook the per-replica caches need
+    return prompts, [f"pool{int(p)}" for p in picks]
+
+
+def make_interarrivals(args, rng):
+    """Open-loop inter-arrival gaps: plain Poisson, or a two-state
+    Markov-modulated (on/off) Poisson for bursty traces."""
+    n = args.requests
+    if args.arrival == "poisson":
+        return rng.exponential(1.0 / args.rate, size=n)
+    # bursty: walk the ON/OFF renewal process; arrivals only during ON
+    gaps, t, on_until, off_until = [], 0.0, 0.0, 0.0
+    on = True
+    on_until = rng.exponential(args.burst_on_s)
+    last = 0.0
+    while len(gaps) < n:
+        if on:
+            step = rng.exponential(1.0 / (args.rate * args.burst_mult))
+            if t + step <= on_until:
+                t += step
+                gaps.append(t - last)
+                last = t
+            else:
+                t = on_until
+                on = False
+                off_until = t + rng.exponential(args.burst_off_s)
+        else:
+            t = off_until
+            on = True
+            on_until = t + rng.exponential(args.burst_on_s)
+    return np.asarray(gaps)
+
+
 def run_load(front, args, chaos=None) -> dict:
     from deepspeed_tpu.inference.serving import QueueFullError
     rng = np.random.default_rng(args.seed)
     n = args.requests
-    prompts = [rng.integers(0, args.vocab_size,
-                            size=int(rng.integers(args.min_prompt,
-                                                  args.max_prompt + 1))
-                            ).astype(np.int32) for _ in range(n)]
+    prompts, sessions = make_prompts(args, rng)
     max_news = [int(rng.integers(args.min_new, args.max_new + 1))
                 for _ in range(n)]
-    inter = rng.exponential(1.0 / args.rate, size=n)
+    inter = make_interarrivals(args, rng)
     t0 = time.monotonic()
     arrivals = t0 + np.cumsum(inter)
+    is_router = hasattr(front, "replicas")
     # pending entries are mutable [ready_time, idx]: a rejected request backs
     # off independently (jittered), it never blocks later arrivals
     pending = [[float(arrivals[i]), i] for i in range(n)]
@@ -78,10 +142,11 @@ def run_load(front, args, chaos=None) -> dict:
         now = time.monotonic()
         for entry in [e for e in pending if e[0] <= now]:
             idx = entry[1]
+            kwargs = dict(max_new_tokens=max_news[idx], seed=idx)
+            if is_router:
+                kwargs["session"] = sessions[idx]
             try:
-                handles[idx] = front.submit(prompts[idx],
-                                            max_new_tokens=max_news[idx],
-                                            seed=idx)
+                handles[idx] = front.submit(prompts[idx], **kwargs)
                 pending.remove(entry)
             except QueueFullError as e:   # backpressure: jittered client retry
                 resubmits += 1
@@ -94,7 +159,6 @@ def run_load(front, args, chaos=None) -> dict:
             # overhead into the latency numbers this benchmark reports
             time.sleep(max(0.0, min(e[0] for e in pending) - time.monotonic()))
     wall = time.monotonic() - t0
-    is_router = hasattr(front, "replicas")
     snap = front.snapshot() if is_router else front.telemetry.snapshot()
     snap["wall_s"] = wall
     snap["submitted"] = len(handles)
@@ -126,6 +190,43 @@ def run_load(front, args, chaos=None) -> dict:
                     parity_ok = False
             snap["parity_checked"] = verified
             snap["parity_ok"] = parity_ok
+    # hit-vs-miss TTFT split + measured hit-rate (prefix-cache acceptance):
+    # a request is a hit when its first token came from a restored-prefix
+    # suffix prefill on whichever attempt produced it
+    if args.prefix_cache or args.prefix_pool:
+        done = [h for h in handles.values() if h.ttft is not None]
+        hit_t = [h.ttft * 1e3 for h in done if h.prefix_hit_tokens > 0]
+        miss_t = [h.ttft * 1e3 for h in done if h.prefix_hit_tokens == 0]
+
+        def pct(xs, q):
+            return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+        snap["prefix_trace"] = {
+            "hit_requests": len(hit_t),
+            "miss_requests": len(miss_t),
+            "measured_hit_rate": (len(hit_t) / len(done) if done else 0.0),
+            "ttft_hit_ms_p50": pct(hit_t, 50),
+            "ttft_hit_ms_p95": pct(hit_t, 95),
+            "ttft_miss_ms_p50": pct(miss_t, 50),
+            "ttft_miss_ms_p95": pct(miss_t, 95),
+        }
+        if args.prefix_cache:
+            snap["prefix_cache_report"] = front.prefix_cache_report()
+    if args.verify_parity:
+        # the bit-exactness gate: EVERY request's served tokens must equal the
+        # cache-off per-request generate (greedy only — sampled streams are
+        # seeded per request but generate uses a different key stream)
+        ref_engine = (front.replicas[0].engine if is_router
+                      else front.executor.engine)
+        bad = 0
+        for idx, h in handles.items():
+            ref = np.asarray(ref_engine.generate(
+                prompts[idx][None, :], max_new_tokens=max_news[idx]))
+            if not np.array_equal(h.result(), ref[0, prompts[idx].size:]):
+                bad += 1
+        snap["full_parity_checked"] = len(handles)
+        snap["full_parity_bad"] = bad
+        snap["parity_ok"] = snap.get("parity_ok", True) and bad == 0
     return snap
 
 
@@ -134,6 +235,35 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--rate", type=float, default=8.0,
                     help="mean arrivals per second (Poisson)")
+    ap.add_argument("--arrival", default="poisson",
+                    choices=("poisson", "bursty"),
+                    help="bursty = Markov-modulated on/off Poisson")
+    ap.add_argument("--burst-on-s", type=float, default=0.5,
+                    help="mean ON-state holding time (bursty)")
+    ap.add_argument("--burst-off-s", type=float, default=1.0,
+                    help="mean OFF-state holding time (bursty)")
+    ap.add_argument("--burst-mult", type=float, default=4.0,
+                    help="ON-state rate multiplier over --rate (bursty)")
+    ap.add_argument("--prefix-pool", type=int, default=0,
+                    help="draw system prompts from a pool of N shared "
+                         "prefixes (0 = independent prompts)")
+    ap.add_argument("--prefix-len", type=int, default=64,
+                    help="shared-prefix length in tokens")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable the radix prompt-prefix KV cache")
+    ap.add_argument("--prefix-cache-mb", type=float, default=256.0,
+                    help="prefix-cache HBM byte budget (MiB)")
+    ap.add_argument("--prefix-min-hit", type=int, default=8,
+                    help="minimum matched tokens for a cache hit")
+    ap.add_argument("--prefix-insert-on", default="prefill",
+                    choices=("prefill", "completion"),
+                    help="when a prompt's KV slab enters the trie")
+    ap.add_argument("--verify-parity", action="store_true",
+                    help="re-check EVERY request bit-identical vs cache-off "
+                         "per-request generate (greedy acceptance gate)")
+    ap.add_argument("--out", default=None,
+                    help="also write the BENCH JSON to this file "
+                         "(e.g. BENCH_PREFIX_r09.json)")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--chunk-size", type=int, default=8)
     ap.add_argument("--max-queue", type=int, default=8)
@@ -176,6 +306,23 @@ def main(argv=None) -> int:
             # mid-request: longer generations, capacity for the retries
             args.requests, args.max_queue = 8, 8
             args.min_new, args.max_new, args.max_seq_len = 10, 16, 64
+        if args.prefix_pool:
+            # shared-prefix smoke: a couple of pool prompts, prefixes long
+            # enough to clear the hit threshold, room in the KV cap
+            args.requests = max(args.requests, 8)
+            args.prefix_pool = min(args.prefix_pool, 2)
+            args.prefix_len = min(args.prefix_len, 16)
+            args.prefix_min_hit = min(args.prefix_min_hit, 8)
+            args.max_queue = max(args.max_queue, 8)
+            args.max_seq_len = max(args.max_seq_len,
+                                   args.prefix_len + args.max_prompt
+                                   + args.max_new + 8)
+    if args.prefix_pool:
+        need = args.prefix_len + args.max_prompt + args.max_new + 1
+        if args.max_seq_len < need:
+            ap.error(f"--max-seq-len {args.max_seq_len} too small for "
+                     f"prefix({args.prefix_len}) + tail({args.max_prompt}) + "
+                     f"new({args.max_new}); need >= {need}")
     if args.chaos and args.replicas < 2:
         ap.error("--chaos needs --replicas >= 2")
     if args.chaos and args.chunk_deadline is None:
@@ -193,9 +340,18 @@ def main(argv=None) -> int:
         monitor = MonitorMaster(MonitorConfig(jsonl_monitor={
             "enabled": True, "output_path": args.jsonl_metrics,
             "job_name": "loadgen"}))
+    prefix_cfg = None
+    if args.prefix_cache:
+        from deepspeed_tpu.inference.serving import PrefixCacheConfig
+        prefix_cfg = PrefixCacheConfig(
+            max_bytes=int(args.prefix_cache_mb * 1024 * 1024),
+            min_hit_tokens=args.prefix_min_hit,
+            min_insert_tokens=args.prefix_min_hit,
+            insert_on=args.prefix_insert_on)
     serving_cfg = ServingConfig(
         slots=args.slots, chunk_size=args.chunk_size, max_queue=args.max_queue,
-        max_seq_len=args.max_seq_len, chunk_deadline_s=args.chunk_deadline)
+        max_seq_len=args.max_seq_len, chunk_deadline_s=args.chunk_deadline,
+        prefix_cache=prefix_cfg)
     chaos = None
     if args.replicas > 1:
         from deepspeed_tpu.inference.serving import (ChaosSchedule, Router,
@@ -218,9 +374,27 @@ def main(argv=None) -> int:
            "value": detail["tokens_per_sec"], "unit": "tok/s",
            "vs_baseline": 0.0, "smoke": bool(args.smoke),
            "chaos": args.chaos, "detail": detail}
-    print(json.dumps(out))
     ok = detail["all_finished"] and detail["lost"] == 0 \
         and detail.get("parity_ok", True)
+    if args.prefix_pool and args.prefix_cache:
+        # the prefix-cache acceptance gates ride the JSON so the bench
+        # artifact is self-certifying
+        trace = detail["prefix_trace"]
+        hit_p50, miss_p50 = (trace["ttft_hit_ms_p50"],
+                             trace["ttft_miss_ms_p50"])
+        out["prefix_gates"] = {
+            "hit_rate": trace["measured_hit_rate"],
+            "hit_rate_ge_0p7": trace["measured_hit_rate"] >= 0.7,
+            "ttft_hit_over_miss_p50": (hit_p50 / miss_p50
+                                       if hit_p50 and miss_p50 else None),
+            "hit_ttft_le_quarter_miss": bool(hit_p50 and miss_p50
+                                             and hit_p50 <= 0.25 * miss_p50),
+            "parity_ok": detail.get("parity_ok", True),
+        }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out))
     return 0 if ok else 1
 
 

@@ -104,6 +104,31 @@ def build_prefill(module, dequant, overlap=None):
     return prefill
 
 
+def build_prefix_prefill(module, dequant, overlap=None):
+    """Suffix prefill at a nonzero cache offset — the prefix-cache hit path.
+
+    ``caches`` arrive with a restored prompt-prefix KV slab in rows
+    ``[0, prefix_len)``; the forward runs over the (right-padded) suffix only,
+    writes suffix K/V at rows ``prefix_len + i``, attends each suffix token over
+    prefix + suffix, and reads logits at the suffix's last valid position. The
+    prefix's prefill compute is skipped entirely — a cache hit costs one
+    suffix-bucket forward instead of a full-prompt one.
+    """
+
+    def prefix_prefill(params, ids, caches, prefix_len, suffix_len):
+        b, t = ids.shape
+        positions = prefix_len[:, None] + jnp.arange(t)[None]
+        with overlap_scope(overlap):
+            logits, new_caches = module.apply(
+                {"params": dequant(params)}, ids, positions=positions,
+                caches=caches, cache_lens=prefix_len,
+                logits_positions=jnp.maximum(suffix_len - 1, 0),
+                prefix_fill=True)
+        return logits[:, 0], new_caches
+
+    return prefix_prefill
+
+
 def build_decode_loop(module, dequant, select, gen_cap: int, overlap=None):
     """Whole-batch run-to-completion decode: ONE ``lax.while_loop`` for all remaining
     tokens, EOS termination as an on-device reduction in the loop condition

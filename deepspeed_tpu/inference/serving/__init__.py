@@ -10,10 +10,15 @@ Layers (bottom-up):
   chunks of K steps over the slot-batch (one compile per (slots, cap, chunk,
   sampling) key), per-slot prefill bucketed by prompt length, optional per-chunk
   watchdog deadline (:class:`ChunkTimeoutError`);
+- :mod:`prefix_cache` — :class:`PrefixCache`: radix/trie index over token-ID
+  prefixes whose entries hold gathered KV slabs (LRU under an HBM byte budget,
+  exact match by token); a hit restores the slab into the slot and prefills
+  only the suffix, so shared system prompts skip prefill;
 - :mod:`scheduler` — :class:`ContinuousBatchingScheduler`: bounded request queue
   with admission control, backpressure (reject-with-retry-after), deadlines,
-  cancellation, slot recycling between chunks, and whole-replica eviction
-  (``evict_all``) for the router's checkpointless retry;
+  cancellation, slot recycling between chunks, per-replica prefix-cache
+  lookup/insert, and whole-replica eviction (``evict_all``) for the router's
+  checkpointless retry;
 - :mod:`router` — :class:`Router`: N engine replicas behind one admission queue
   with least-outstanding dispatch, session affinity, the
   LIVE→SUSPECT→DEAD→RECOVERING health state machine, checkpointless request
@@ -27,6 +32,7 @@ Layers (bottom-up):
 from .chaos import ChaosEvent, ChaosSchedule, parse_chaos
 from .executor import ChunkedDecodeExecutor, ChunkTimeoutError
 from .kv_pool import SlotKVPool
+from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .router import (EngineReplica, ReplicaDeadError, ReplicaState, Router,
                      RouterConfig, RouterDrainingError, RouterRequest,
                      RouterRequestState, RouterTelemetry)
@@ -36,6 +42,7 @@ from .telemetry import ServingTelemetry
 
 __all__ = [
     "ChunkedDecodeExecutor", "ChunkTimeoutError", "SlotKVPool",
+    "PrefixCache", "PrefixCacheConfig",
     "ContinuousBatchingScheduler", "QueueFullError", "RequestHandle",
     "RequestState", "ServingConfig", "ServingTelemetry",
     "Router", "RouterConfig", "RouterRequest", "RouterRequestState",

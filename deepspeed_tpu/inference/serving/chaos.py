@@ -7,6 +7,10 @@ semicolon-separated events, each ``kind:key=val,...``:
     kill:replica=1,when=busy       # kill replica 1 the moment it has in-flight
                                    # work with >=1 generated token (guarantees a
                                    # real mid-decode eviction, deterministically)
+    kill:replica=1,when=restore    # kill replica 1 in the window BETWEEN its
+                                   # next prefix-slab restore and the suffix
+                                   # prefill (prefix-cache soak lane: guards the
+                                   # restore path's donation discipline)
     stall:replica=0,when=busy,s=0.6   # wedge replica 0's next chunk for 0.6s
                                       # (the chunk watchdog turns this into a
                                       # ChunkTimeoutError)
@@ -15,7 +19,10 @@ semicolon-separated events, each ``kind:key=val,...``:
 
 Events fire at most once. ``at`` is seconds since :class:`ChaosSchedule` start;
 ``when=busy`` fires on the first poll where the target replica has a running
-request. ``poll()`` is called from the driving loop (loadgen / serve).
+request. ``when=restore`` (kill only) arms the executor's restore-kill hook on
+the first poll and counts as fired once a cache-hit admission actually trips it
+— it lands *inside* a scheduler step, a boundary ``poll()`` alone can never
+hit. ``poll()`` is called from the driving loop (loadgen / serve).
 """
 
 import time
@@ -32,9 +39,10 @@ class ChaosEvent:
     kind: str                       # kill | stall | revive
     replica: int
     at: Optional[float] = None      # seconds after schedule start
-    when: Optional[str] = None      # "busy"
+    when: Optional[str] = None      # "busy" | "restore"
     duration: float = 0.5           # stall seconds
     fired: bool = False
+    armed: bool = False             # when=restore: hook installed, not yet hit
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -43,8 +51,11 @@ class ChaosEvent:
         if self.at is None and self.when is None:
             raise ValueError(f"chaos event {self.kind!r} needs at=<s> or "
                              "when=busy")
-        if self.when is not None and self.when != "busy":
+        if self.when is not None and self.when not in ("busy", "restore"):
             raise ValueError(f"unknown chaos trigger when={self.when!r}")
+        if self.when == "restore" and self.kind != "kill":
+            raise ValueError("when=restore is a kill-only trigger (it models "
+                             "death inside the restore->prefill window)")
 
 
 def parse_chaos(spec: str) -> List[ChaosEvent]:
@@ -99,7 +110,32 @@ class ChaosSchedule:
                 raise ValueError(f"chaos event {ev.kind!r} targets replica "
                                  f"{ev.replica} but the router has only "
                                  f"{len(router.replicas)}")
-            if ev.fired or not self._due(ev, router, now):
+            if ev.fired:
+                continue
+            if ev.when == "restore":
+                # two-phase: arm the executor hook once; it fires inside the
+                # next cache-hit admission (between restore and suffix
+                # prefill), a window in-between-steps polling cannot reach
+                replica = router.replicas[ev.replica]
+                if replica.scheduler.prefix_cache is None:
+                    # without a prefix cache the hook is unreachable and the
+                    # soak would pass vacuously ("a chaos run must never
+                    # degrade to nothing")
+                    raise ValueError(
+                        f"chaos when=restore targets replica {ev.replica} "
+                        "but its prefix cache is disabled — enable "
+                        "ServingConfig.prefix_cache (--prefix-cache)")
+                ex = replica.scheduler.executor
+                if not ev.armed:
+                    ex.arm_restore_kill(replica.kill)
+                    ev.armed = True
+                    logger.warning(f"[chaos] armed restore-kill on replica "
+                                   f"{ev.replica}")
+                elif not ex.restore_kill_pending:
+                    ev.fired = True           # the hook was consumed
+                    applied.append(ev)
+                continue
+            if not self._due(ev, router, now):
                 continue
             ev.fired = True
             replica = router.replicas[ev.replica]

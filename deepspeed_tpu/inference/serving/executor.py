@@ -37,7 +37,8 @@ import numpy as np
 
 from ...models.causal_lm import init_cache
 from ...utils.fault_injection import fault_point
-from ..decode_fns import build_decode_chunk, build_prefill, make_slot_select_fn
+from ..decode_fns import (build_decode_chunk, build_prefill,
+                          build_prefix_prefill, make_slot_select_fn)
 from .kv_pool import SlotKVPool
 
 
@@ -109,6 +110,8 @@ class ChunkedDecodeExecutor:
         self.cold_chunk_grace_s = float(cold_chunk_grace_s)
         self._warm_chunk = False        # first successful chunk marks warm
         self._stall_next = 0.0
+        self._restore_kill = None       # chaos hook: fires between prefix
+        #   restore and suffix prefill (see arm_restore_kill)
 
     @property
     def chunk_warm(self) -> bool:
@@ -116,6 +119,19 @@ class ChunkedDecodeExecutor:
         which ``chunk_deadline_s`` is enforced at face value (the first chunk is
         granted ``cold_chunk_grace_s`` to cover its XLA compile)."""
         return self._warm_chunk
+
+    def arm_restore_kill(self, callback) -> None:
+        """Chaos hook: invoke ``callback`` exactly once, in the window between
+        the prefix-slab restore and the suffix prefill of the next cache-hit
+        admission, then abort that admission attempt — the deterministic
+        stand-in for a replica dying with a restored-but-unprefilled slot. The
+        scheduler's prefill retry re-runs the whole restore (donation-safe:
+        ``restore_prefix`` rebinds the pool before this hook can fire)."""
+        self._restore_kill = callback
+
+    @property
+    def restore_kill_pending(self) -> bool:
+        return self._restore_kill is not None
 
     def stall_next(self, seconds: float) -> None:
         """Chaos hook: make the next chunk stall ``seconds`` inside the timed
@@ -164,6 +180,41 @@ class ChunkedDecodeExecutor:
             fns[key] = jax.jit(prefill)
         return fns[key]
 
+    def _suffix_prefill_fn(self, bucket: int):
+        """Cache-hit prefill: gather the slot's batch-1 cache view (holding the
+        restored prefix slab), run the suffix forward at the prefix offset,
+        scatter the row back. The POOL caches flow through and are donated —
+        same compile-key discipline as the chunk fn, one compile per
+        (slots, cap, suffix-bucket, sampling) key."""
+        key = ("serve_suffix_prefill", self.slots, self.cap, bucket,
+               self.sampling)
+        fns = self.engine._fns
+        if key not in fns:
+            engine = self.engine
+            prefix_prefill = build_prefix_prefill(
+                engine.module, engine._dequant,
+                overlap=getattr(engine, "comm_overlap", None))
+            select = self._slot_select
+
+            def prefill(params, caches, slot, ids, prefix_len, suffix_len,
+                        seed, base_key):
+                one = [{"k": jax.lax.dynamic_slice_in_dim(c["k"], slot, 1, 0),
+                        "v": jax.lax.dynamic_slice_in_dim(c["v"], slot, 1, 0)}
+                       for c in caches]
+                logits, new_one = prefix_prefill(params, ids, one, prefix_len,
+                                                 suffix_len)
+                tok0 = select(logits, base_key, seed, jnp.zeros_like(seed))
+                caches = [
+                    {"k": jax.lax.dynamic_update_slice_in_dim(
+                        c["k"], n["k"].astype(c["k"].dtype), slot, 0),
+                     "v": jax.lax.dynamic_update_slice_in_dim(
+                        c["v"], n["v"].astype(c["v"].dtype), slot, 0)}
+                    for c, n in zip(caches, new_one)]
+                return tok0, caches
+
+            fns[key] = jax.jit(prefill, donate_argnums=(1,))
+        return fns[key]
+
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.buckets:
             if prompt_len <= b:
@@ -172,19 +223,54 @@ class ChunkedDecodeExecutor:
                          f"{self.max_prompt_len}")
 
     # -------------------------------------------------------------------- steps
-    def prefill_into_slot(self, slot: int, prompt: np.ndarray, seed: int = 0
+    def prefill_into_slot(self, slot: int, prompt: np.ndarray, seed: int = 0,
+                          prefix_len: int = 0, prefix_slab=None
                           ) -> Tuple[int, float]:
         """Prefill ``prompt`` (1-D int tokens) and scatter its KV into ``slot``.
+
+        With ``prefix_len > 0`` (prefix-cache hit): restore ``prefix_slab``
+        into the slot via the pool's donated scatter, then prefill ONLY the
+        suffix ``prompt[prefix_len:]`` at cache offset ``prefix_len`` — the
+        prompt bucket is chosen by **suffix** length, so a 128-token cached
+        system prompt with an 8-token user turn pays an 8-bucket forward, not a
+        256-bucket one. The ``serving.prefix_restore`` fault point (and the
+        chaos ``when=restore`` hook) sits exactly between restore and suffix
+        prefill — the boundary whose donation discipline the soak guards.
 
         Returns ``(first_token, prefill_seconds)`` — the first token is
         host-synced before the clock stops, so the scheduler's TTFT is honest.
         """
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         t = prompt.shape[0]
+        self.engine._activate()
+        if prefix_len:
+            if not 0 < prefix_len < t:
+                raise ValueError(f"prefix_len must be in (0, prompt_len={t}), "
+                                 f"got {prefix_len}")
+            suffix = prompt[prefix_len:]
+            bucket = self.bucket_for(suffix.size)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :suffix.size] = suffix
+            fn = self._suffix_prefill_fn(bucket)
+            t0 = time.perf_counter()
+            self.pool.restore_prefix(slot, prefix_slab)
+            fault_point("serving.prefix_restore")
+            if self._restore_kill is not None:
+                cb, self._restore_kill = self._restore_kill, None
+                cb()
+                raise RuntimeError("chaos: replica killed between prefix "
+                                   "restore and suffix prefill")
+            tok0, caches = fn(self.engine.params, self.pool.caches,
+                              np.int32(slot), jnp.asarray(ids),
+                              jnp.asarray([prefix_len], jnp.int32),
+                              jnp.asarray([suffix.size], jnp.int32),
+                              jnp.asarray([seed], jnp.int32), self._base_key)
+            self.pool.caches = caches
+            tok0 = int(np.asarray(tok0)[0, 0])          # host sync: honest TTFT
+            return tok0, time.perf_counter() - t0
         bucket = self.bucket_for(t)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :t] = prompt
-        self.engine._activate()
         fn = self._prefill_fn(bucket)
         t0 = time.perf_counter()
         tok0, one_caches = fn(self.engine.params, jnp.asarray(ids),

@@ -1,11 +1,14 @@
 """Slot-indexed KV cache pool.
 
 One fixed allocation of ``init_cache(cfg, slots, cap)`` per pool; requests borrow a
-slot (row) for their lifetime. All three mutations — scatter-in of a prefill's
-batch-1 cache, zero-fill on release — run as donated jitted updates, so the pool's
-HBM footprint is constant: jax 0.4.37 honours ``donate_argnums`` on CPU too, so
-there are no backend guards (guarding donation behind backend checks cost 1500x on
-pool scatters in an earlier revision of this codebase).
+slot (row) for their lifetime. Every pool mutation — scatter-in of a prefill's
+batch-1 cache, prefix-slab restore on a cache hit, zero-fill on release — runs as
+a donated jitted update, so the pool's HBM footprint is constant: jax 0.4.37
+honours ``donate_argnums`` on CPU too, so there are no backend guards (guarding
+donation behind backend checks cost 1500x on pool scatters in an earlier revision
+of this codebase). ``gather_prefix`` is the one non-donating copy-out: it hands
+the prefix cache (and, next, disaggregated prefill) slabs whose lifetime is
+independent of the pool's.
 
 Per-slot sequence lengths are scheduler state (host numpy, passed into each decode
 chunk); the pool owns only the device buffers and the free list.
@@ -29,6 +32,10 @@ class SlotKVPool:
         self.cap = int(cap)
         self.caches = init_cache(model_config, self.slots, self.cap, dtype=dtype)
         self._free: List[int] = list(range(self.slots))
+        # prefix-cache slab movers, one compile per padded row count R (row
+        # counts are power-of-two prompt buckets, so the key set is tiny)
+        self._gather_fns: Dict[int, Any] = {}
+        self._restore_fns: Dict[int, Any] = {}
 
         def scatter(caches, one, slot):
             return [{"k": c["k"].at[slot].set(o["k"][0]),
@@ -61,6 +68,65 @@ class SlotKVPool:
     def scatter_prefill(self, slot: int, one_caches: List[Dict[str, Any]]) -> None:
         """Write a prefill's batch-1 per-layer cache into row ``slot``."""
         self.caches = self._scatter_fn(self.caches, one_caches, np.int32(slot))
+
+    # --------------------------------------------------------- prefix-cache I/O
+    def gather_prefix(self, slot: int, rows: int) -> List[Dict[str, Any]]:
+        """Copy rows ``[0, rows)`` of ``slot`` out as an independent KV slab
+        (per-layer ``{"k": (hk, rows, d), "v": ...}``) — the prefix-cache
+        insert path, and the slab disaggregated prefill will ship to decode
+        replicas. NOT donated: the pool keeps serving; the slab's lifetime is
+        the trie's, so pool rebuilds after faults never invalidate it."""
+        R = int(rows)
+        if not 0 < R <= self.cap:
+            raise ValueError(f"rows must be in [1, cap={self.cap}], got {R}")
+        fn = self._gather_fns.get(R)
+        if fn is None:
+            def gather(caches, slot):
+                out = []
+                for c in caches:
+                    _, hk, _, d = c["k"].shape
+                    out.append({
+                        "k": jax.lax.dynamic_slice(
+                            c["k"], (slot, 0, 0, 0), (1, hk, R, d))[0],
+                        "v": jax.lax.dynamic_slice(
+                            c["v"], (slot, 0, 0, 0), (1, hk, R, d))[0]})
+                return out
+            fn = self._gather_fns[R] = jax.jit(gather)
+        return fn(self.caches, np.int32(slot))
+
+    def slab_nbytes(self, rows: int) -> int:
+        """Host-side size of a ``rows``-row slab — lets callers apply byte
+        budgets BEFORE paying the device gather."""
+        total = 0
+        for c in self.caches:
+            _, hk, _, d = c["k"].shape
+            total += 2 * hk * int(rows) * d * c["k"].dtype.itemsize
+        return total
+
+    def restore_prefix(self, slot: int, slab: List[Dict[str, Any]]) -> None:
+        """Write a gathered KV slab into rows ``[0, slab_rows)`` of ``slot`` —
+        the donated scatter on the cache-hit path (``scatter_prefill``'s
+        prefix-restore sibling). The pool buffers are donated (the old ones are
+        dead after the update); the slab is NOT (it stays resident in the
+        trie for the next hit)."""
+        R = int(slab[0]["k"].shape[1])
+        if R > self.cap:
+            raise ValueError(f"slab rows {R} exceed pool cap {self.cap}")
+        fn = self._restore_fns.get(R)
+        if fn is None:
+            def restore(caches, slab, slot):
+                out = []
+                for c, s in zip(caches, slab):
+                    out.append({
+                        "k": jax.lax.dynamic_update_slice(
+                            c["k"], s["k"][None].astype(c["k"].dtype),
+                            (slot, 0, 0, 0)),
+                        "v": jax.lax.dynamic_update_slice(
+                            c["v"], s["v"][None].astype(c["v"].dtype),
+                            (slot, 0, 0, 0))})
+                return out
+            fn = self._restore_fns[R] = jax.jit(restore, donate_argnums=(0,))
+        self.caches = fn(self.caches, slab, np.int32(slot))
 
     # ------------------------------------------------------------------ metrics
     @property

@@ -1,0 +1,300 @@
+"""Radix prompt-prefix KV cache: shared system prompts skip prefill.
+
+A path-compressed trie over token-ID prefixes whose entries hold **KV slabs** —
+per-layer ``{"k": (hk, R, d), "v": (hk, R, d)}`` device arrays gathered from a
+:class:`~.kv_pool.SlotKVPool` slot after that prompt's prefill (rows padded to
+the prompt's power-of-two bucket ``R``; the real covered length is the entry's
+trie depth). On admission the scheduler walks the trie, splits the prompt into
+``cached_prefix + suffix``, restores the slab into the slot and prefills only
+the suffix — a hit costs one suffix-bucket forward instead of a full-prompt
+prefill (the serving-side analogue of SGLang's RadixAttention, specialized to
+this codebase's fixed-shape compiled-chunk world).
+
+Contracts:
+
+- **exact match by token** — a lookup only ever reuses KV rows whose token path
+  is identical, token for token, to the prompt's own prefix. There are no
+  approximate/fuzzy hits; a single differing token ends the match. Matches may
+  end mid-edge (a stored longer prompt's first ``m`` rows are a valid slab for
+  any prompt sharing those ``m`` tokens — K/V at row ``i`` depend only on
+  tokens ``0..i``);
+- **bit-exactness is a caller property** — slab rows are the *verbatim* device
+  buffers a full prefill wrote, so greedy decode after a restore continues the
+  identical token stream (asserted end-to-end in the serving tests and the
+  chaos soak);
+- **a hit never covers the whole prompt** — at least one suffix token is always
+  left to prefill, because the first generated token comes from the suffix
+  forward's logits;
+- **LRU under a byte budget** — every insert/hit front-moves the entry; inserts
+  evict least-recently-used slabs until ``max_bytes`` holds. Slabs are
+  independent device buffers (gathered copies), so pool rebuilds after replica
+  faults never invalidate them; only real process death does (the router's
+  ``revive`` clears the cache for exactly that reason).
+
+Thread-safety: none needed — the cache lives inside a single-threaded
+scheduler, like every other serving structure here.
+"""
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class PrefixCacheConfig:
+    """``ServingConfig.prefix_cache``; ``None`` disables the cache entirely."""
+    enabled: bool = True
+    max_bytes: int = 256 * 1024 * 1024   # HBM budget for cached slabs
+    min_hit_tokens: int = 8              # shorter matches re-prefill in full
+    min_insert_tokens: int = 8           # don't cache trivially short prompts
+    insert_on: str = "completion"        # "completion" | "prefill" (watermark:
+    #   insert the moment prefill lands, so concurrent same-prefix requests hit)
+
+    def __post_init__(self):
+        if self.insert_on not in ("completion", "prefill"):
+            raise ValueError(f"insert_on must be 'completion' or 'prefill', "
+                             f"got {self.insert_on!r}")
+        if self.max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {self.max_bytes}")
+
+
+def slab_bytes(slab: List[Dict]) -> int:
+    """Device bytes held by one per-layer KV slab."""
+    return sum(int(s["k"].nbytes) + int(s["v"].nbytes) for s in slab)
+
+
+class _Entry:
+    """A cached slab anchored at a trie node (depth == covered token count)."""
+    __slots__ = ("slab", "tokens", "bytes", "node")
+
+    def __init__(self, slab: List[Dict], tokens: int, node: "_Node"):
+        self.slab = slab            # per-layer {"k": (hk, R, d), "v": ...}
+        self.tokens = int(tokens)   # real covered rows (== node depth)
+        self.bytes = slab_bytes(slab)
+        self.node = node
+
+
+class _Node:
+    """Path-compressed trie node; ``edge`` is the token run from the parent."""
+    __slots__ = ("edge", "children", "parent", "entry", "depth")
+
+    def __init__(self, edge: np.ndarray, parent: Optional["_Node"],
+                 depth: int):
+        self.edge = edge                      # (len,) int32 tokens from parent
+        self.children: Dict[int, "_Node"] = {}
+        self.parent = parent
+        self.entry: Optional[_Entry] = None
+        self.depth = int(depth)               # tokens root -> this node
+
+
+def _common_len(a: np.ndarray, b: np.ndarray) -> int:
+    n = min(a.size, b.size)
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if neq.size else n
+
+
+class PrefixCache:
+    """Radix trie over token-ID prefixes; leaves hold KV slabs; LRU by bytes."""
+
+    def __init__(self, config: Optional[PrefixCacheConfig] = None):
+        self.config = config or PrefixCacheConfig()
+        self.root = _Node(np.zeros(0, np.int32), None, 0)
+        self._lru: "OrderedDict[int, _Entry]" = OrderedDict()  # id(entry) keyed
+        self.total_bytes = 0
+        # counters (telemetry reads these through stats())
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0          # prefill tokens skipped via restores
+        self.lookup_tokens = 0       # prompt tokens seen by lookup
+        self.inserted = 0
+        self.evicted = 0
+        self.insert_skipped = 0      # too short / over-budget single slab
+
+    # ------------------------------------------------------------------ lookup
+    def lookup(self, prompt) -> Tuple[int, Optional[_Entry]]:
+        """Longest exact token match usable as a restored prefix.
+
+        Returns ``(matched_tokens, entry)``; ``(0, None)`` is a miss. The
+        returned entry's slab covers *at least* ``matched_tokens`` valid rows
+        (restore writes the whole padded slab; rows beyond the match are
+        overwritten by the suffix prefill or masked by ``cache_len``).
+        ``matched_tokens`` is capped at ``len(prompt) - 1`` so the suffix is
+        never empty, and matches below ``min_hit_tokens`` report as misses.
+        """
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        self.lookup_tokens += int(prompt.size)
+        node, i = self.root, 0
+        best_anchor: Optional[_Entry] = None     # deepest full-node entry
+        best_anchor_len = 0
+        stopped: Optional[_Node] = None          # subtree a mid-edge match hit
+        while i < prompt.size:
+            child = node.children.get(int(prompt[i]))
+            if child is None:
+                break
+            m = _common_len(prompt[i:], child.edge)
+            i += m
+            if m < child.edge.size:
+                # diverged (or prompt ended) mid-edge: every entry below
+                # `child` still shares the first `i` tokens with the prompt
+                stopped = child
+                break
+            node = child
+            if node.entry is not None:
+                best_anchor, best_anchor_len = node.entry, node.depth
+        matched, entry = best_anchor_len, best_anchor
+        # deeper option: any entry in the subtree we stopped in covers `i`
+        sub = stopped if stopped is not None else node
+        if i > matched:
+            deeper = self._first_entry(sub)
+            if deeper is not None:
+                matched, entry = i, deeper
+        usable = min(matched, int(prompt.size) - 1)
+        if entry is None or usable < max(1, self.config.min_hit_tokens):
+            self.misses += 1
+            return 0, None
+        self.hits += 1
+        self.hit_tokens += usable
+        self._touch(entry)
+        return usable, entry
+
+    def contains(self, prompt) -> bool:
+        """Exact-path probe: is this full prompt already indexed? (Read-only
+        walk — lets callers skip the device gather whose slab ``insert`` would
+        only drop; refreshes the resident entry's LRU position on True, since
+        the caller's intent was an insert-or-touch.)"""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        node, i = self.root, 0
+        while i < prompt.size:
+            child = node.children.get(int(prompt[i]))
+            if child is None:
+                return False
+            m = _common_len(prompt[i:], child.edge)
+            i += m
+            if m < child.edge.size:
+                return False
+            node = child
+        if node.depth == prompt.size and node.entry is not None:
+            self._touch(node.entry)
+            return True
+        return False
+
+    def _first_entry(self, node: _Node) -> Optional[_Entry]:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.entry is not None:
+                return n.entry
+            stack.extend(n.children.values())
+        return None
+
+    # ------------------------------------------------------------------ insert
+    def insert(self, prompt, slab: List[Dict]) -> bool:
+        """Index ``slab`` (rows padded; rows ``[0, len(prompt))`` are the
+        prompt's KV) under the full prompt token path. Re-inserting an already
+        cached path just refreshes its LRU position (same tokens ⇒ bit-identical
+        KV, so the resident slab is kept and the new one dropped). Returns True
+        when the slab is (now) resident."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size < max(1, self.config.min_insert_tokens):
+            self.insert_skipped += 1
+            return False
+        nbytes = slab_bytes(slab)
+        if nbytes > self.config.max_bytes:
+            self.insert_skipped += 1
+            return False
+        node = self._descend(prompt)
+        if node.entry is not None:
+            self._touch(node.entry)
+            return True
+        entry = _Entry(slab, prompt.size, node)
+        node.entry = entry
+        self._lru[id(entry)] = entry
+        self.total_bytes += entry.bytes
+        self.inserted += 1
+        self._evict_to_budget(keep=entry)
+        return True
+
+    def _descend(self, tokens: np.ndarray) -> _Node:
+        """Walk/extend/split the trie so a node exists exactly at ``tokens``."""
+        node, i = self.root, 0
+        while i < tokens.size:
+            child = node.children.get(int(tokens[i]))
+            if child is None:
+                new = _Node(tokens[i:].copy(), node, tokens.size)
+                node.children[int(tokens[i])] = new
+                return new
+            m = _common_len(tokens[i:], child.edge)
+            if m == child.edge.size:
+                node, i = child, i + m
+                continue
+            # split child's edge at m
+            mid = _Node(child.edge[:m].copy(), node,
+                        child.depth - (child.edge.size - m))
+            node.children[int(tokens[i])] = mid
+            child.edge = child.edge[m:]
+            child.parent = mid
+            mid.children[int(child.edge[0])] = child
+            node, i = mid, i + m
+        return node
+
+    # ---------------------------------------------------------------- eviction
+    def _touch(self, entry: _Entry) -> None:
+        self._lru.move_to_end(id(entry))
+
+    def _evict_to_budget(self, keep: Optional[_Entry] = None) -> int:
+        evicted = 0
+        while self.total_bytes > self.config.max_bytes and self._lru:
+            victim = next(iter(self._lru.values()))
+            if victim is keep:
+                break        # never evict the slab being inserted
+            self._remove(victim)
+            evicted += 1
+        return evicted
+
+    def _remove(self, entry: _Entry) -> None:
+        del self._lru[id(entry)]
+        self.total_bytes -= entry.bytes
+        self.evicted += 1
+        node = entry.node
+        node.entry = None
+        # prune entry-less leaf chains so the trie doesn't accrete dead paths
+        while (node.parent is not None and node.entry is None
+               and not node.children):
+            parent = node.parent
+            del parent.children[int(node.edge[0])]
+            node = parent
+
+    def clear(self) -> None:
+        """Drop everything (models HBM loss on replica process death)."""
+        self.root = _Node(np.zeros(0, np.int32), None, 0)
+        self._lru.clear()
+        self.total_bytes = 0
+
+    # ----------------------------------------------------------------- metrics
+    @property
+    def entries(self) -> int:
+        return len(self._lru)
+
+    @property
+    def hit_rate(self) -> float:
+        n = self.hits + self.misses
+        return self.hits / n if n else 0.0
+
+    def stats(self) -> Dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hit_rate,
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "inserted": self.inserted,
+            "evicted": self.evicted,
+            "insert_skipped": self.insert_skipped,
+            "entries": self.entries,
+            "cached_bytes": self.total_bytes,
+            "max_bytes": self.config.max_bytes,
+        }

@@ -125,6 +125,8 @@ class RouterRequest:
     attempts: int = 0                 # dispatches so far
     retried: int = 0                  # re-enqueues after eviction/failure
     evictions: int = 0
+    prefix_hit_tokens: int = 0        # from the attempt that produced the
+    #   first token (the one TTFT measures) — loadgen splits TTFT on this
     excluded: Set[int] = field(default_factory=set)   # replica exclusion list
     replica_id: Optional[int] = None
     inner: Optional[object] = None    # current attempt's RequestHandle
@@ -213,10 +215,14 @@ class EngineReplica:
         """Bring the replica back, modeling a FRESH process: any scheduler
         state from before the kill is discarded (the router already evicted
         and requeued those requests — leaving them would resume zombie decode
-        of work now owned by other replicas)."""
+        of work now owned by other replicas), and the prefix cache is cleared
+        — a real process death loses its HBM slabs, so the in-process
+        simulation must not resurrect them."""
         self._killed = False
         if self.scheduler.busy:
             self.scheduler.evict_all(reason="revive")
+        if self.scheduler.prefix_cache is not None:
+            self.scheduler.prefix_cache.clear()
         self.last_heartbeat = time.monotonic()
         self.last_pump_attempt = self.last_heartbeat
 
@@ -274,8 +280,10 @@ class RouterTelemetry:
     Monitor tags: ``router/queue_depth``, ``router/retried_total``,
     ``router/evicted_total``, ``router/completed_total``,
     ``router/rejected_total``, ``router/replica{i}/health`` (state code),
-    ``router/replica{i}/outstanding``, ``router/drain_ms``, per-request
-    ``router/ttft_ms`` / ``router/tpot_ms``.
+    ``router/replica{i}/outstanding``, ``router/replica{i}/prefix_hit_rate``
+    (prefix cache enabled only — caches are strictly per-replica, so hit rate
+    is a per-replica property that session affinity concentrates),
+    ``router/drain_ms``, per-request ``router/ttft_ms`` / ``router/tpot_ms``.
     """
 
     def __init__(self, monitor=None, n_replicas: int = 1):
@@ -314,6 +322,9 @@ class RouterTelemetry:
                        float(health[r.id].state.code), self._tick))
             ev.append((f"router/replica{r.id}/outstanding",
                        float(r.outstanding), self._tick))
+            if r.scheduler.prefix_cache is not None:
+                ev.append((f"router/replica{r.id}/prefix_hit_rate",
+                           float(r.scheduler.prefix_hit_rate), self._tick))
         self._write(ev)
 
     def on_transition(self, replica_id: int, old: ReplicaState,
@@ -495,7 +506,26 @@ class Router:
             r.scheduler.telemetry.tokens_total for r in self.replicas)
         snap["replica_health"] = {r.id: self.health[r.id].state.value
                                   for r in self.replicas}
+        if any(r.scheduler.prefix_cache is not None for r in self.replicas):
+            snap["prefix_cache"] = self.prefix_cache_report()
         return snap
+
+    def prefix_cache_report(self) -> Dict:
+        """Per-replica prefix-cache reports + the aggregate hit accounting
+        (caches are per-replica by design; no cross-replica coherence)."""
+        per = {f"replica{r.id}": r.scheduler.prefix_cache_report()
+               for r in self.replicas}
+        hits = sum(p.get("hits", 0) for p in per.values())
+        misses = sum(p.get("misses", 0) for p in per.values())
+        return {
+            "enabled": any(p.get("enabled") for p in per.values()),
+            "hits": hits, "misses": misses,
+            "hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+            "hit_tokens": sum(p.get("hit_tokens", 0) for p in per.values()),
+            "cached_bytes": sum(p.get("cached_bytes", 0)
+                                for p in per.values()),
+            **per,
+        }
 
     # ------------------------------------------------------------------- drain
     def begin_drain(self) -> None:
@@ -753,6 +783,8 @@ class Router:
             if rr.first_token_at is None and rr.inner.first_token_at is not None:
                 rr.first_token_at = rr.inner.first_token_at
                 rr.ttft = rr.first_token_at - rr.arrival
+                rr.prefix_hit_tokens = getattr(rr.inner, "prefix_hit_tokens",
+                                               0)
             rr.inner = None
 
     def _harvest(self, now: float) -> None:

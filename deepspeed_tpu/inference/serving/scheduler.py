@@ -45,6 +45,7 @@ import numpy as np
 from ...utils.fault_injection import fault_point, retry_with_backoff
 from ...utils.logging import logger
 from .executor import ChunkedDecodeExecutor
+from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .telemetry import ServingTelemetry
 
 
@@ -83,6 +84,7 @@ class ServingConfig:
     retry_base_delay: float = 0.02
     base_seed: int = 0
     chunk_deadline_s: Optional[float] = None   # per-chunk watchdog (None = off)
+    prefix_cache: Optional[PrefixCacheConfig] = None   # None = cache off
 
 
 def validate_admission(prompt, max_new_tokens: Optional[int],
@@ -123,6 +125,8 @@ class RequestHandle:
     slot: Optional[int] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    prefix_hit_tokens: int = 0          # prefill tokens skipped via the
+    #   prefix cache (0 = cold miss); loadgen splits TTFT on this
     _cancel: bool = False
 
     def cancel(self) -> None:
@@ -156,6 +160,9 @@ class ContinuousBatchingScheduler:
             chunk_deadline_s=cfg.chunk_deadline_s)
         self.cap = cap
         self.telemetry = ServingTelemetry(monitor)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if cfg.prefix_cache is not None and cfg.prefix_cache.enabled:
+            self.prefix_cache = PrefixCache(cfg.prefix_cache)
         self.queue: Deque[RequestHandle] = deque()
         self._ids = itertools.count()
         S = cfg.slots
@@ -210,7 +217,10 @@ class ContinuousBatchingScheduler:
         self._sweep_running(now)
         admitted = self._admit()
         decoded = self._decode_chunk()
-        self.telemetry.on_step(len(self.queue), self.executor.pool.occupancy)
+        self.telemetry.on_step(
+            len(self.queue), self.executor.pool.occupancy,
+            prefix_stats=(None if self.prefix_cache is None
+                          else self.prefix_cache.stats()))
         return admitted or decoded
 
     def run(self, max_steps: int = 100000) -> dict:
@@ -221,6 +231,69 @@ class ContinuousBatchingScheduler:
             self.step()
             steps += 1
         return self.telemetry.snapshot()
+
+    # ------------------------------------------------------------ prefix cache
+    def _insert_prefix(self, handle: RequestHandle, slot: int) -> None:
+        """Gather the slot's prompt-KV rows (padded to the prompt bucket) and
+        index them in the trie under the full prompt token path."""
+        if self.prefix_cache is None:
+            return
+        P = int(handle.prompt.size)
+        if P < self.prefix_cache.config.min_insert_tokens:
+            self.prefix_cache.insert_skipped += 1
+            return                   # skip the device gather, not just the insert
+        if self.prefix_cache.contains(handle.prompt):
+            return                   # resident (LRU refreshed): same tokens ⇒
+            #   bit-identical slab, don't pay the gather to drop it
+        rows = self.executor.bucket_for(P)
+        if self.executor.pool.slab_nbytes(rows) > \
+                self.prefix_cache.config.max_bytes:
+            self.prefix_cache.insert_skipped += 1
+            return                   # could never fit: skip the gather too
+        slab = self.executor.pool.gather_prefix(slot, rows)
+        self.prefix_cache.insert(handle.prompt, slab)
+
+    def _retire_prefix(self, handle: RequestHandle, slot: int) -> None:
+        """Completion-path insert hook: runs for every request leaving a slot
+        through a healthy retirement (finished / cancelled / expired — the
+        prefill was paid, so its prompt KV is worth keeping). Eviction paths
+        (``evict_all``) deliberately skip it: the pool may be poisoned there.
+        """
+        if (self.prefix_cache is not None
+                and self.prefix_cache.config.insert_on == "completion"):
+            self._insert_prefix(handle, slot)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """ADMISSION-level hit rate (successful prefills) — everything named
+        ``prefix_hit_rate`` (this, the monitor tags, the snapshot) derives
+        from the same counters; the trie's lookup-level rate (which also
+        counts failed/retried admissions) is only in
+        :meth:`prefix_cache_report`."""
+        if self.prefix_cache is None:
+            return 0.0
+        t = self.telemetry
+        n = t.prefix_hits + t.prefix_misses
+        return t.prefix_hits / n if n else 0.0
+
+    def prefix_cache_report(self) -> dict:
+        """``weight_stream_report()``-style summary of the prefix cache: hit
+        accounting, resident slab bytes against budget, and the modeled
+        prefill-compute saving (skipped prefill tokens / total prompt tokens
+        seen). The ``hits``/``misses``/``hit_rate`` here are the trie's
+        LOOKUP-level counters (they also tick on admissions that later fail
+        and retry) — everything published as ``prefix_hit_rate`` elsewhere is
+        admission-level."""
+        if self.prefix_cache is None:
+            return {"enabled": False}
+        s = self.prefix_cache.stats()
+        seen = max(1, s["lookup_tokens"])
+        return {
+            "enabled": True,
+            **s,
+            "budget_fill": s["cached_bytes"] / max(1, s["max_bytes"]),
+            "prefill_tokens_skipped_frac": s["hit_tokens"] / seen,
+        }
 
     # --------------------------------------------------------------- eviction
     def evict_all(self, reason: str = "evicted") -> List[RequestHandle]:
@@ -275,9 +348,11 @@ class ContinuousBatchingScheduler:
             if h is None:
                 continue
             if h._cancel:
+                self._retire_prefix(h, slot)   # prefill was paid: keep its KV
                 self._finalize(h, RequestState.CANCELLED, "cancelled", now)
                 self._release(slot)
             elif self._expired(h, now):
+                self._retire_prefix(h, slot)
                 self._finalize(h, RequestState.EXPIRED, "deadline", now)
                 self._release(slot)
 
@@ -288,9 +363,16 @@ class ContinuousBatchingScheduler:
         while self.queue and self.executor.pool.free_slots > 0:
             handle = self.queue.popleft()
             slot = self.executor.pool.acquire()
+            matched, entry = 0, None
+            if self.prefix_cache is not None:
+                matched, entry = self.prefix_cache.lookup(handle.prompt)
 
-            def attempt(h=handle, s=slot):
+            def attempt(h=handle, s=slot, m=matched, e=entry):
                 fault_point("serving.prefill")
+                if e is not None:
+                    return self.executor.prefill_into_slot(
+                        s, h.prompt, h.seed, prefix_len=m,
+                        prefix_slab=e.slab)
                 return self.executor.prefill_into_slot(s, h.prompt, h.seed)
 
             try:
@@ -303,9 +385,33 @@ class ContinuousBatchingScheduler:
                 # still holding live requests
                 logger.error(f"[serving] prefill failed for request "
                              f"{handle.id}: {type(e).__name__}: {e}")
-                self._finalize(handle, RequestState.CANCELLED, "error",
-                               time.monotonic())
-                self._release(slot)
+                now = time.monotonic()
+                self._finalize(handle, RequestState.CANCELLED, "error", now)
+                if entry is not None:
+                    # cache-hit path: the suffix-prefill dispatch DONATES the
+                    # pool caches (unlike the miss path's batch-1 prefill), so
+                    # a failure here may have consumed them — zero-filling the
+                    # slot or restoring into the old binding would crash the
+                    # loop on deleted buffers. Same recovery as a failed
+                    # decode chunk: fail the in-flight requests, rebuild the
+                    # pool, keep serving (a router retries them elsewhere).
+                    logger.error("[serving] failed prefill was a prefix-cache "
+                                 "hit (donated pool dispatch); failing "
+                                 f"{sum(h is not None for h in self._slot_req)}"
+                                 " in-flight request(s) and rebuilding the "
+                                 "KV pool")
+                    for s2, h2 in enumerate(self._slot_req):
+                        if h2 is not None:
+                            self._finalize(h2, RequestState.CANCELLED,
+                                           "error", now)
+                            self._slot_req[s2] = None
+                    self._active[:] = False
+                    self._remaining[:] = 0
+                    self._steps[:] = 0
+                    self._eos[:] = -1
+                    self.executor.reset_pool()
+                else:
+                    self._release(slot)
                 continue
             now = time.monotonic()
             handle.state = RequestState.RUNNING
@@ -313,8 +419,16 @@ class ContinuousBatchingScheduler:
             handle.tokens.append(int(tok0))
             handle.first_token_at = now
             handle.ttft = now - handle.arrival
+            handle.prefix_hit_tokens = matched if entry is not None else 0
+            self.telemetry.on_prefix(entry is not None,
+                                     handle.prefix_hit_tokens,
+                                     enabled=self.prefix_cache is not None)
+            if (self.prefix_cache is not None
+                    and self.prefix_cache.config.insert_on == "prefill"):
+                self._insert_prefix(handle, slot)
             eos = -1 if handle.eos_token_id is None else int(handle.eos_token_id)
             if tok0 == eos or handle.max_new_tokens == 1:
+                self._retire_prefix(handle, slot)
                 self._finalize(handle, RequestState.FINISHED,
                                "eos" if tok0 == eos else "length", now)
                 self._release(slot)
@@ -387,6 +501,7 @@ class ContinuousBatchingScheduler:
             reason = ("eos" if h.eos_token_id is not None
                       and h.tokens and h.tokens[-1] == h.eos_token_id
                       else "length")
+            self._retire_prefix(h, int(slot))
             self._finalize(h, RequestState.FINISHED, reason, now)
             self._release(int(slot))
         self.telemetry.on_chunk(total, res.elapsed)

@@ -14,6 +14,9 @@ Two modes over the same frontend (a single scheduler, or — with
   the run passes only if every request still completes with greedy outputs
   bit-identical to an unkilled run (checkpointless retry proven end-to-end).
 
+``--prefix-cache`` enables the radix prompt-prefix KV cache (per replica:
+shared system prompts skip prefill, greedy outputs bit-identical to cache-off;
+``--prefix-cache-mb`` bounds the slab HBM budget).
 ``--chaos "<spec>"`` schedules replica kills/stalls (see ``serving.chaos``), and
 a ``DS_TPU_FAULT_SPEC`` env (``utils.fault_injection.fault_env``) is armed at
 startup — the hook chaos tests use to inject deterministically into
@@ -244,6 +247,14 @@ def main(argv=None) -> int:
                          "stall:replica=0,when=busy,s=0.6' (see serving.chaos)")
     ap.add_argument("--chunk-deadline", type=float, default=None,
                     help="per-chunk watchdog deadline in seconds")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable the radix prompt-prefix KV cache (shared "
+                         "system prompts skip prefill; greedy outputs stay "
+                         "bit-identical to cache-off)")
+    ap.add_argument("--prefix-cache-mb", type=float, default=256.0,
+                    help="prefix-cache HBM byte budget (MiB)")
+    ap.add_argument("--prefix-min-hit", type=int, default=8,
+                    help="minimum matched tokens for a cache hit")
     ap.add_argument("--jsonl-metrics", default=None,
                     help="directory for the jsonl monitor backend")
     ap.add_argument("--selftest", action="store_true")
@@ -256,11 +267,19 @@ def main(argv=None) -> int:
     from ...utils.fault_injection import apply_fault_env
     apply_fault_env()
 
+    from .prefix_cache import PrefixCacheConfig
     from .scheduler import ContinuousBatchingScheduler, ServingConfig
+    prefix_cfg = None
+    if args.prefix_cache:
+        prefix_cfg = PrefixCacheConfig(
+            max_bytes=int(args.prefix_cache_mb * 1024 * 1024),
+            min_hit_tokens=args.prefix_min_hit,
+            min_insert_tokens=args.prefix_min_hit)
     serving_cfg = ServingConfig(slots=args.slots, chunk_size=args.chunk_size,
                                 max_queue=args.max_queue,
                                 max_seq_len=args.max_seq_len,
-                                chunk_deadline_s=args.chunk_deadline)
+                                chunk_deadline_s=args.chunk_deadline,
+                                prefix_cache=prefix_cfg)
     monitor = _make_monitor(args)
     chaos = None
     if args.replicas > 1:

@@ -1,0 +1,242 @@
+"""Subprocess-hosted serving replica: a real process boundary for router tests.
+
+The router's in-process :class:`~.router.EngineReplica` simulates death with a
+flag; this module hosts the same engine + scheduler stack in a CHILD process so
+tests can deliver a real ``SIGKILL`` and prove the recovery model end-to-end.
+It exists because the router's whole recovery design is **prefix-only**: the
+parent's view of a replica is nothing but the token prefixes streamed back so
+far, so after a kill the request continues bit-identically by re-prefilling
+``prompt + streamed_prefix`` anywhere else — no shared memory, no checkpoint,
+no device state crosses the process boundary.
+
+Protocol (JSONL over stdin/stdout, every line flushed — the stream must be
+truthful at the instant a SIGKILL lands):
+
+- child → ``{"ready": true, "faults_armed": N}`` once the engine is built
+  (``N`` from :func:`~...utils.fault_injection.apply_fault_env` — the
+  ``DS_TPU_FAULT_SPEC`` env contract arms seeded fault schedules in the child,
+  same as ``deepspeed-serve``);
+- parent → ``{"id": i, "prompt": [...], "max_new_tokens": n, "seed": s,
+  "eos_token_id": e|null}`` submits a request;
+- child → ``{"id": i, "tokens": [...], "done": bool, "state": "..."}`` after
+  every scheduler step in which request ``i`` gained tokens (cumulative
+  prefix, not a delta — idempotent under lost/duplicated reads);
+- parent → ``{"cmd": "stop"}`` (or EOF) drains and exits 0.
+
+Determinism contract: the child builds its engine with the same fixed init
+seed as an in-parent engine of identical dims, so the parent can compute
+bit-exact references with its OWN engine — weights never cross the pipe.
+
+Run as ``python -m deepspeed_tpu.inference.serving.subproc --vocab-size ...``
+(the parent-side :class:`SubprocessReplica` wraps spawn/stream/kill).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def child_main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(prog="serving.subproc")
+    ap.add_argument("--vocab-size", type=int, default=96)
+    ap.add_argument("--max-seq-len", type=int, default=64)
+    ap.add_argument("--n-embd", type=int, default=32)
+    ap.add_argument("--n-layer", type=int, default=2)
+    ap.add_argument("--n-head", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--chunk-size", type=int, default=2)
+    ap.add_argument("--prefix-cache", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from ...utils.fault_injection import apply_fault_env
+    from ..config import DeepSpeedInferenceConfig
+    from ..engine import InferenceEngine
+    from ...models.causal_lm import gpt2_cfg
+    from .prefix_cache import PrefixCacheConfig
+    from .scheduler import ContinuousBatchingScheduler, ServingConfig
+
+    armed = apply_fault_env()       # DS_TPU_FAULT_SPEC: seeded child schedule
+    engine = InferenceEngine(
+        gpt2_cfg(vocab_size=args.vocab_size, max_seq_len=args.max_seq_len,
+                 n_embd=args.n_embd, n_layer=args.n_layer, n_head=args.n_head,
+                 dtype=jnp.float32),
+        DeepSpeedInferenceConfig(dtype="float32",
+                                 max_out_tokens=args.max_seq_len))
+    prefix = PrefixCacheConfig(min_hit_tokens=4, min_insert_tokens=4,
+                               insert_on="prefill") if args.prefix_cache \
+        else None
+    sched = ContinuousBatchingScheduler(engine, ServingConfig(
+        slots=args.slots, chunk_size=args.chunk_size,
+        max_seq_len=args.max_seq_len, prefix_cache=prefix))
+
+    out = sys.stdout
+
+    def emit(obj):
+        out.write(json.dumps(obj) + "\n")
+        out.flush()                 # every line visible before any SIGKILL
+
+    emit({"ready": True, "pid": os.getpid(), "faults_armed": armed})
+
+    lines: List[str] = []
+    eof = threading.Event()
+
+    def reader():
+        for line in sys.stdin:
+            if line.strip():
+                lines.append(line.strip())
+        eof.set()
+
+    threading.Thread(target=reader, daemon=True).start()
+    handles: Dict[int, object] = {}
+    reported: Dict[int, int] = {}
+    stop = False
+    while not stop or sched.busy:
+        while lines:
+            req = json.loads(lines.pop(0))
+            if req.get("cmd") == "stop":
+                stop = True
+                continue
+            h = sched.submit(req["prompt"],
+                             max_new_tokens=req.get("max_new_tokens"),
+                             eos_token_id=req.get("eos_token_id"),
+                             seed=req.get("seed", 0))
+            handles[int(req["id"])] = h
+        if eof.is_set():
+            stop = True
+        if sched.busy:
+            sched.step()
+        elif not stop:
+            time.sleep(0.005)
+        for rid, h in list(handles.items()):
+            n = len(h.tokens)
+            if n != reported.get(rid) or h.done:
+                reported[rid] = n
+                emit({"id": rid, "tokens": [int(t) for t in h.tokens],
+                      "done": bool(h.done), "state": h.state.value,
+                      "prefix_hit_tokens": h.prefix_hit_tokens})
+                if h.done:
+                    del handles[rid]
+    emit({"summary": sched.telemetry.snapshot()})
+    return 0
+
+
+class SubprocessReplica:
+    """Parent-side handle on a subprocess-hosted replica.
+
+    Spawns the child, streams its JSONL progress on a reader thread, and keeps
+    the per-request **token prefixes** — the only state the recovery model is
+    allowed to use. ``sigkill()`` is a real ``SIGKILL``: no atexit, no flush,
+    no goodbye; whatever was streamed is all the parent has, exactly like a
+    preempted TPU host."""
+
+    def __init__(self, repo_root: str, env: Optional[Dict[str, str]] = None,
+                 prefix_cache: bool = False, **dims):
+        cmd = [sys.executable, "-m", "deepspeed_tpu.inference.serving.subproc"]
+        for k, v in dims.items():
+            cmd += [f"--{k.replace('_', '-')}", str(v)]
+        if prefix_cache:
+            cmd += ["--prefix-cache"]
+        full_env = dict(os.environ)
+        full_env.setdefault("JAX_PLATFORMS", "cpu")
+        if env:
+            full_env.update(env)
+        self.proc = subprocess.Popen(
+            cmd, cwd=repo_root, env=full_env, text=True,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        self.ready: Optional[Dict] = None
+        self.progress: Dict[int, Dict] = {}      # id -> last streamed line
+        self.summary: Optional[Dict] = None
+        self._lock = threading.Lock()
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            with self._lock:
+                if "ready" in obj:
+                    self.ready = obj
+                elif "summary" in obj:
+                    self.summary = obj["summary"]
+                elif "id" in obj:
+                    self.progress[int(obj["id"])] = obj
+
+    def wait_ready(self, timeout: float = 120.0) -> Dict:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            with self._lock:
+                if self.ready is not None:
+                    return self.ready
+            if self.proc.poll() is not None:
+                raise RuntimeError("subprocess replica died during startup")
+            time.sleep(0.02)
+        raise TimeoutError("subprocess replica never became ready")
+
+    def submit(self, rid: int, prompt, max_new_tokens: int, seed: int = 0,
+               eos_token_id: Optional[int] = None) -> None:
+        self.proc.stdin.write(json.dumps(
+            {"id": int(rid), "prompt": [int(t) for t in prompt],
+             "max_new_tokens": int(max_new_tokens), "seed": int(seed),
+             "eos_token_id": eos_token_id}) + "\n")
+        self.proc.stdin.flush()
+
+    def tokens(self, rid: int) -> List[int]:
+        """The streamed prefix — all the parent may know about a request."""
+        with self._lock:
+            obj = self.progress.get(int(rid))
+            return list(obj["tokens"]) if obj else []
+
+    def done(self, rid: int) -> bool:
+        with self._lock:
+            obj = self.progress.get(int(rid))
+            return bool(obj and obj["done"])
+
+    def wait_tokens(self, rid: int, n: int, timeout: float = 180.0
+                    ) -> List[int]:
+        """Block until request ``rid`` has streamed >= n tokens (or finished)."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            toks = self.tokens(rid)
+            if len(toks) >= n or self.done(rid):
+                return toks
+            if self.proc.poll() is not None:
+                return toks          # died: the streamed prefix is the answer
+            time.sleep(0.02)
+        raise TimeoutError(f"request {rid}: {len(self.tokens(rid))}/{n} "
+                           "tokens before timeout")
+
+    def sigkill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def stop(self) -> int:
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.write(json.dumps({"cmd": "stop"}) + "\n")
+                self.proc.stdin.flush()
+                self.proc.stdin.close()
+            except (BrokenPipeError, OSError):
+                pass
+            self.proc.wait(timeout=60)
+        return self.proc.returncode
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+if __name__ == "__main__":
+    sys.exit(child_main())

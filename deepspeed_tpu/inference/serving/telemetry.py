@@ -7,7 +7,11 @@ Event tags (step semantics in parentheses):
 - ``serving/ttft_ms``, ``serving/tpot_ms`` — per finished request (completion idx);
 - ``serving/tokens_per_sec`` — per decode chunk (chunk idx);
 - ``serving/queue_depth``, ``serving/slot_occupancy`` — per scheduler step (tick);
-- ``serving/completed_total``, ``serving/rejected_total`` — per scheduler step.
+- ``serving/completed_total``, ``serving/rejected_total`` — per scheduler step;
+- ``serving/prefix_hit_rate``, ``serving/prefix_cached_bytes``,
+  ``serving/prefix_evicted_total`` — per scheduler step, prefix cache enabled
+  only (hit/miss/inserted/evicted counters + cached-token bytes ride the
+  aggregate snapshot).
 """
 
 import time
@@ -33,6 +37,12 @@ class ServingTelemetry:
         self.expired = 0
         self.evicted = 0
         self.decode_seconds = 0.0
+        # prefix-cache counters (only advanced when the cache is enabled)
+        self.prefix_enabled = False
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self._prefix_stats = None    # latest PrefixCache.stats() gauge set
         self._t_start = time.perf_counter()
 
     # ------------------------------------------------------------------- emits
@@ -40,12 +50,39 @@ class ServingTelemetry:
         if self.monitor is not None and getattr(self.monitor, "enabled", False):
             self.monitor.write_events(events)
 
-    def on_step(self, queue_depth: int, occupancy: float) -> None:
+    def on_step(self, queue_depth: int, occupancy: float,
+                prefix_stats=None) -> None:
         self._tick += 1
-        self._write([("serving/queue_depth", float(queue_depth), self._tick),
-                     ("serving/slot_occupancy", float(occupancy), self._tick),
-                     ("serving/completed_total", float(self.completed), self._tick),
-                     ("serving/rejected_total", float(self.rejected), self._tick)])
+        ev = [("serving/queue_depth", float(queue_depth), self._tick),
+              ("serving/slot_occupancy", float(occupancy), self._tick),
+              ("serving/completed_total", float(self.completed), self._tick),
+              ("serving/rejected_total", float(self.rejected), self._tick)]
+        if prefix_stats is not None:
+            self._prefix_stats = prefix_stats
+            # hit_rate here is ADMISSION-level (successful prefills), the same
+            # quantity the snapshot publishes under the same name — the trie's
+            # own lookup-level counters (which also tick on failed/retried
+            # admissions) live in prefix_cache_report() only
+            n = self.prefix_hits + self.prefix_misses
+            ev += [("serving/prefix_hit_rate",
+                    self.prefix_hits / n if n else 0.0, self._tick),
+                   ("serving/prefix_cached_bytes",
+                    float(prefix_stats["cached_bytes"]), self._tick),
+                   ("serving/prefix_evicted_total",
+                    float(prefix_stats["evicted"]), self._tick)]
+        self._write(ev)
+
+    def on_prefix(self, hit: bool, tokens: int, enabled: bool = True) -> None:
+        """Per-admission hit/miss accounting (``tokens`` = prefill tokens
+        skipped via the restored prefix; 0 on a miss)."""
+        if not enabled:
+            return
+        self.prefix_enabled = True
+        if hit:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += int(tokens)
+        else:
+            self.prefix_misses += 1
 
     def on_chunk(self, tokens: int, elapsed: float) -> None:
         self._chunk_idx += 1
@@ -89,7 +126,22 @@ class ServingTelemetry:
 
     def snapshot(self) -> Dict:
         elapsed = time.perf_counter() - self._t_start
+        prefix = {}
+        if self.prefix_enabled or self._prefix_stats is not None:
+            n = self.prefix_hits + self.prefix_misses
+            prefix = {
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_hit_rate": self.prefix_hits / n if n else 0.0,
+                "prefix_hit_tokens": self.prefix_hit_tokens,
+            }
+            if self._prefix_stats is not None:
+                prefix["prefix_inserted"] = self._prefix_stats["inserted"]
+                prefix["prefix_evicted"] = self._prefix_stats["evicted"]
+                prefix["prefix_cached_bytes"] = \
+                    self._prefix_stats["cached_bytes"]
         return {
+            **prefix,
             "elapsed_s": elapsed,
             "completed": self.completed,
             "rejected": self.rejected,

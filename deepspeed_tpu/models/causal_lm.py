@@ -452,8 +452,14 @@ class CausalLMLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Dict] = None,
-                 cache_len: Optional[jnp.ndarray] = None):
+                 cache_len: Optional[jnp.ndarray] = None,
+                 prefix_fill: bool = False):
         """x: (b, t, d). With ``cache`` given (decode): t==1, attention against the cache.
+        With ``prefix_fill`` (static): suffix prefill at a nonzero cache offset —
+        ``cache`` already holds a restored prompt-prefix KV slab in rows
+        ``[0, cache_len)``, the t suffix tokens write their K/V at rows
+        ``cache_len + i`` and attend over prefix + suffix (the prefix-cache hit
+        path: the prefix's prefill compute is skipped entirely).
         Returns (y, new_cache_kv or None)."""
         cfg = self.config
         b, t, _ = x.shape
@@ -476,6 +482,21 @@ class CausalLMLayer(nn.Module):
             new_kv = {"k": k_cache, "v": v_cache}
             o = _sharded_decode(q[:, 0], k_cache, v_cache, cache_len + 1,
                                 alibi=slopes)[:, None]
+        elif cache is not None and prefix_fill:
+            # suffix prefill at offset cache_len: scatter suffix K/V into rows
+            # [cache_len, cache_len + t) (OOB pad rows drop), attend each suffix
+            # query over every cache row at position <= its own
+            k_hm = k.transpose(0, 2, 1, 3)   # (b, hk, t, d)
+            v_hm = v.transpose(0, 2, 1, 3)
+            idx = cache_len[:, None] + jnp.arange(t)[None]        # (b, t)
+
+            def put(c, n, i):
+                return c.at[:, i, :].set(n.astype(c.dtype))
+
+            k_cache = jax.vmap(put)(cache["k"], k_hm, idx)
+            v_cache = jax.vmap(put)(cache["v"], v_hm, idx)
+            new_kv = {"k": k_cache, "v": v_cache}
+            o = _prefix_attention_xla(q, k_cache, v_cache, cache_len, slopes)
         else:
             o = _bias_attention(q, k, v, slopes)
             if cache is not None:
@@ -538,6 +559,34 @@ def _alibi_attention_xla(q, k, v, slopes):
     logits = jnp.where(causal[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _prefix_attention_xla(q, k_cache, v_cache, offset, slopes=None):
+    """Suffix-prefill attention: queries at global positions ``offset + i``
+    over the full KV cache (restored prefix rows + just-written suffix rows),
+    masked ``key_pos <= query_pos`` — the t×T generalisation of
+    ``decode_attention_xla_alibi``'s 1×T shape. fp32 softmax like every other
+    XLA attention path here; rows beyond ``offset + t - 1`` (stale slab pad /
+    unwritten) are masked out by construction.
+
+    q: (b, t, h, d); k_cache/v_cache: (b, hk, T, d); offset: (b,)."""
+    b, t, h, d = q.shape
+    hk, T = k_cache.shape[1], k_cache.shape[2]
+    g = h // hk
+    scale = 1.0 / float(np.sqrt(d))
+    q5 = q.reshape(b, t, hk, g, d).astype(jnp.float32)
+    s = jnp.einsum("btkgd,bkTd->bkgtT", q5,
+                   k_cache.astype(jnp.float32)) * scale
+    q_pos = offset[:, None] + jnp.arange(t)[None]                  # (b, t)
+    k_pos = jnp.arange(T)
+    if slopes is not None:
+        rel = (k_pos[None, None, :] - q_pos[:, :, None]).astype(jnp.float32)
+        s = s + slopes.reshape(1, hk, g, 1, 1) * rel[:, None, None]
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]               # (b, t, T)
+    s = jnp.where(mask[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgtT,bkTd->btkgd", p, v_cache.astype(jnp.float32))
+    return o.reshape(b, t, h, d).astype(q.dtype)
 
 
 def _cache_update(cache, new, cache_len):
@@ -608,12 +657,17 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, caches=None, cache_lens=None,
-                 logits_positions=None):
+                 logits_positions=None, prefix_fill=False):
         """``logits_positions`` (b,): compute the LM head ONLY at these sequence
         positions (serving prefill needs just each prompt's last valid token — for a
         250k vocab at t=512 this removes ~99.8% of the head matmul and the (b, t, V)
         fp32 logits buffer; reference parity: ds_inference reads final-token logits).
-        Returns (b, 1, V) logits in that mode."""
+        Returns (b, 1, V) logits in that mode.
+
+        ``prefix_fill`` (static): suffix prefill at cache offset ``cache_lens``
+        — the caches already hold a restored prompt-prefix KV slab; the caller
+        must pass ``positions = cache_lens + arange(t)`` so rotary/learned
+        embeddings see global positions."""
         cfg = self.config
         b, t = input_ids.shape
         if positions is None:
@@ -633,7 +687,8 @@ class CausalLM(nn.Module):
             layer_cache = None if caches is None else caches[i]
             x, new_kv = CausalLMLayer(cfg, is_moe=cfg.is_moe_layer(i),
                                       name=f"layers_{i}")(
-                x, positions, cache=layer_cache, cache_len=cache_lens)
+                x, positions, cache=layer_cache, cache_len=cache_lens,
+                prefix_fill=prefix_fill)
             new_caches.append(new_kv)
 
         x = _norm(cfg, "ln_f")(x)

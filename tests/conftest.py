@@ -43,6 +43,10 @@ def pytest_configure(config):
         "markers", "weight_quant: weight-streaming quantized decode lane "
         "(int4 packing, fused dequant-matmul parity, audit, bench --wq "
         "smoke) — tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "prefix_cache: radix prompt-prefix KV cache lane (trie "
+        "semantics, LRU eviction, suffix prefill, hit-vs-miss greedy parity, "
+        "restore-boundary chaos, subprocess SIGKILL retry) — tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -58,7 +62,8 @@ def pytest_collection_modifyitems(config, items):
         if "test_fault_tolerance" in it.nodeid:
             return 0
         if "inference/serving" in it.nodeid \
-                or it.get_closest_marker("serving_router") is not None:
+                or it.get_closest_marker("serving_router") is not None \
+                or it.get_closest_marker("prefix_cache") is not None:
             return 1
         if it.get_closest_marker("comm_overlap") is not None:
             return 2
