@@ -35,6 +35,16 @@ evicted-and-retried request's final output is re-checked bit-identical against
 an unkilled per-request ``generate``. ``--verify-parity`` extends that re-check
 to EVERY request (the prefix-cache bit-exactness acceptance gate).
 
+Observability (PR 10, ``docs/OBSERVABILITY.md``): ``--trace-out FILE`` enables
+the request-scoped span tracer for the run and writes a Perfetto-loadable
+Chrome trace on exit (documented alongside ``--jsonl-metrics`` — one is the
+span stream, the other the metric stream of the same spine). ``--obs-ab`` runs
+the tracing-overhead acceptance A/B instead of a single run: the same arrival
+trace is replayed ``--obs-reps`` times per arm, arms interleaved
+(off, on, off, on, ...) over ONE engine (shared compile cache, so the A/B
+measures tracing, not compilation), and the BENCH JSON gates
+tracing-enabled TPOT within 2% of tracing-off (``BENCH_OBS_r10.json``).
+
 ``--smoke`` shrinks everything (tiny model, few requests) to a seconds-long run —
 the mode the serving tests execute in-process.
 
@@ -160,6 +170,16 @@ def run_load(front, args, chaos=None) -> dict:
             time.sleep(max(0.0, min(e[0] for e in pending) - time.monotonic()))
     wall = time.monotonic() - t0
     snap = front.snapshot() if is_router else front.telemetry.snapshot()
+    # exact (non-bucketed) per-run percentiles from the raw handles: the
+    # telemetry histogram quantizes to ~8% log buckets — fine for dashboards,
+    # too coarse for the obs-overhead A/B's 2% gate
+    tpots = [h.tpot * 1e3 for h in handles.values() if h.tpot is not None]
+    ttfts = [h.ttft * 1e3 for h in handles.values() if h.ttft is not None]
+    snap["tpot_ms_p50_exact"] = (float(np.percentile(tpots, 50))
+                                 if tpots else None)
+    snap["tpot_ms_mean_exact"] = float(np.mean(tpots)) if tpots else None
+    snap["ttft_ms_p50_exact"] = (float(np.percentile(ttfts, 50))
+                                 if ttfts else None)
     snap["wall_s"] = wall
     snap["submitted"] = len(handles)
     snap["backpressure_events"] = resubmits      # client-side resubmissions
@@ -290,6 +310,14 @@ def main(argv=None) -> int:
                          "(defaults to 0.3 in chaos mode)")
     ap.add_argument("--jsonl-metrics", default=None,
                     help="directory for the jsonl monitor backend")
+    ap.add_argument("--trace-out", default=None,
+                    help="enable request-scoped tracing; write a Perfetto-"
+                         "loadable Chrome trace here at the end of the run")
+    ap.add_argument("--obs-ab", action="store_true",
+                    help="tracing-overhead A/B: interleaved off/on reps over "
+                         "one engine; BENCH JSON gates TPOT overhead < 2%%")
+    ap.add_argument("--obs-reps", type=int, default=3,
+                    help="repetitions per arm of the --obs-ab run")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-long tiny-model run (used by the test suite)")
     args = ap.parse_args(argv)
@@ -352,6 +380,18 @@ def main(argv=None) -> int:
         slots=args.slots, chunk_size=args.chunk_size, max_queue=args.max_queue,
         max_seq_len=args.max_seq_len, chunk_deadline_s=args.chunk_deadline,
         prefix_cache=prefix_cfg)
+    if args.obs_ab:
+        if args.replicas > 1 or args.chaos:
+            ap.error("--obs-ab measures the single-scheduler hot path; "
+                     "drop --replicas/--chaos")
+        if args.trace_out:
+            ap.error("--obs-ab manages tracing itself (on/off arms); "
+                     "--trace-out is a single-run option")
+        return _run_obs_ab(args, serving_cfg)
+    from deepspeed_tpu.observability.trace import get_tracer
+    tracer = None
+    if args.trace_out:
+        tracer = get_tracer().enable(pid_label="loadgen")
     chaos = None
     if args.replicas > 1:
         from deepspeed_tpu.inference.serving import (ChaosSchedule, Router,
@@ -391,11 +431,118 @@ def main(argv=None) -> int:
                                              and hit_p50 <= 0.25 * miss_p50),
             "parity_ok": detail.get("parity_ok", True),
         }
+    if tracer is not None:
+        n = tracer.export_chrome(args.trace_out)
+        out["trace"] = {"path": args.trace_out, "spans": n,
+                        "dropped": tracer.dropped}
+        tracer.disable()
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
     print(json.dumps(out))
     return 0 if ok else 1
+
+
+def _med_notnull(xs):
+    """Median over the non-None entries; None when nothing survived (a rep
+    whose requests all failed must read as a failed gate, not a traceback)."""
+    vals = [x for x in xs if x is not None]
+    return float(np.median(vals)) if vals else None
+
+
+def _run_obs_ab(args, serving_cfg) -> int:
+    """Tracing-overhead acceptance A/B: the same request set replayed with the
+    span tracer off vs on, arms interleaved over ONE engine (shared compile
+    cache — the A/B isolates tracing cost from compilation). Emits the
+    ``BENCH_OBS`` JSON with the <2% TPOT gate.
+
+    The gated quantity is **aggregate TPOT under saturation**: arrivals are
+    forced open-throttle so the scheduler is always busy and
+    ``wall_s / tokens_total`` measures the pure per-token serving cost —
+    per-request TPOT percentiles under open-loop arrivals carry queueing
+    variance an order of magnitude above the 2% gate (they ride along in
+    ``detail``). Deltas are paired per rep and order-alternated so machine
+    drift cancels."""
+    from deepspeed_tpu.inference.serving import ContinuousBatchingScheduler
+    from deepspeed_tpu.observability.trace import get_tracer
+    tracer = get_tracer()
+    args.rate = max(args.rate, 1000.0)      # saturate: measure serving, not
+    args.max_queue = max(args.max_queue, args.requests)   # arrival gaps
+    serving_cfg.max_queue = args.max_queue
+    engine = build_engine(args)
+    # warmup: pays every prefill-bucket + chunk compile, discarded
+    run_load(ContinuousBatchingScheduler(engine, serving_cfg), args)
+    arms = {"off": [], "on": []}
+    span_counts = []
+    for rep in range(max(1, args.obs_reps)):
+        # interleaved AND order-alternated (off,on / on,off / ...): the second
+        # run of a pair sees warmer allocator/cache state, which reads as a
+        # systematic arm bias unless the position is balanced
+        order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+        for arm in order:
+            if arm == "on":
+                tracer.enable(pid_label="loadgen-ab")
+                tracer.reset()
+            else:
+                tracer.disable()
+            snap = run_load(ContinuousBatchingScheduler(engine, serving_cfg),
+                            args)
+            if arm == "on":
+                span_counts.append(len(tracer.spans))
+            arms[arm].append(snap)
+    tracer.disable()
+
+    def med(arm, key):
+        return _med_notnull(s.get(key) for s in arms[arm])
+
+    tpot_off, tpot_on = (med("off", "tpot_ms_p50_exact"),
+                         med("on", "tpot_ms_p50_exact"))
+
+    def agg_ms_per_tok(s):
+        return (s["wall_s"] / s["tokens_total"] * 1e3
+                if s.get("tokens_total") else None)
+
+    # paired per-rep deltas (each on-rep against its adjacent off-rep over the
+    # identical request set), median across reps: slow machine drift hits
+    # both arms of a pair equally and cancels, unlike a cross-rep median
+    deltas = [(agg_ms_per_tok(b) - agg_ms_per_tok(a)) / agg_ms_per_tok(a)
+              for a, b in zip(arms["off"], arms["on"])
+              if agg_ms_per_tok(a) and agg_ms_per_tok(b)]
+    overhead = float(np.median(deltas)) if deltas else None
+    out = {
+        "metric": "obs_tracing_tpot_overhead_frac",
+        "value": overhead, "unit": "frac", "smoke": bool(args.smoke),
+        "obs_gates": {
+            "agg_tpot_ms_per_token_off": _med_notnull(
+                agg_ms_per_tok(s) for s in arms["off"]),
+            "agg_tpot_ms_per_token_on": _med_notnull(
+                agg_ms_per_tok(s) for s in arms["on"]),
+            "tpot_ms_p50_off": tpot_off,
+            "tpot_ms_p50_on": tpot_on,
+            "tpot_overhead_frac": overhead,
+            "tpot_within_2pct": bool(overhead is not None
+                                     and overhead <= 0.02),
+            "spans_per_on_rep": (float(np.median(span_counts))
+                                 if span_counts else 0.0),
+        },
+        "detail": {
+            "reps": args.obs_reps,
+            "paired_tpot_deltas": deltas,     # per-pair noise, artifact-honest
+            "tokens_per_sec_off": med("off", "tokens_per_sec"),
+            "tokens_per_sec_on": med("on", "tokens_per_sec"),
+            "tpot_ms_mean_off": med("off", "tpot_ms_mean_exact"),
+            "tpot_ms_mean_on": med("on", "tpot_ms_mean_exact"),
+            "ttft_ms_p50_off": med("off", "ttft_ms_p50_exact"),
+            "ttft_ms_p50_on": med("on", "ttft_ms_p50_exact"),
+            "completed_off": sum(s["completed"] for s in arms["off"]),
+            "completed_on": sum(s["completed"] for s in arms["on"]),
+        },
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if out["obs_gates"]["tpot_within_2pct"] else 1
 
 
 if __name__ == "__main__":

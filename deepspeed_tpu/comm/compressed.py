@@ -17,6 +17,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..utils.nvtx import named_scope
+
 
 def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
     """(n,) bool -> (ceil(n/8),) uint8 bitmask."""
@@ -87,6 +89,12 @@ def int8_blockwise_decompress(q: jnp.ndarray, scales: jnp.ndarray, n: int,
 
 def quantized_allreduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str,
                         block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    with named_scope("comm.quantized_allreduce"):
+        return _quantized_allreduce(x, error, axis_name, block)
+
+
+def _quantized_allreduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str,
+                         block: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Error-compensated int8 blockwise mean over ``axis_name`` (call inside
     ``shard_map``); returns ``(replicated quantized mean, new local error)``.
 

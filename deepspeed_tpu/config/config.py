@@ -120,13 +120,16 @@ class MonitorConfig(ConfigModel):
 
 
 class FlopsProfilerConfig(ConfigModel):
-    """Reference ``profiling/config.py``."""
+    """Reference ``profiling/config.py`` (+ ``peak_tflops`` for the modeled
+    ``Train/mfu`` registry event — defaults to the engine's device-kind table
+    when unset; unknown kinds skip the event)."""
     enabled: bool = False
     profile_step: int = 1
     module_depth: int = -1
     top_modules: int = 1
     detailed: bool = True
     output_file: Optional[str] = None
+    peak_tflops: Optional[float] = None
 
 
 class PipelineConfig(ConfigModel):

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability.metrics import record_events as obs_record_events
 from ..models.causal_lm import (CausalLM, CausalLMConfig, causal_lm_param_specs,
                                 init_cache)
 from ..parallel.mesh import AXIS_DATA, AXIS_TENSOR, MeshSpec, set_global_mesh
@@ -358,10 +359,10 @@ class InferenceEngine:
         attach: matrix decisions and the modeled weight-stream reduction."""
         self._monitor = monitor
         audit = getattr(self, "quant_audit", None)
-        if monitor is not None and getattr(monitor, "enabled", False) and audit:
+        if audit:
             rep = self.weight_stream_report()
             n_q = sum(1 for e in audit if e["decision"] == "quantized")
-            monitor.write_events([
+            events = [
                 ("inference/weight_quant/bits", float(self._wq.bits), 0),
                 ("inference/weight_quant/matrices_quantized", float(n_q), 0),
                 ("inference/weight_quant/matrices_kept_fp",
@@ -370,7 +371,10 @@ class InferenceEngine:
                  float(rep["modeled_step_bytes"]), 0),
                 ("inference/weight_quant/reduction_vs_bf16",
                  float(rep["reduction_total"]), 0),
-            ])
+            ]
+            obs_record_events(events)    # registry: independent of monitor
+            if monitor is not None and getattr(monitor, "enabled", False):
+                monitor.write_events(events)
         return self
 
     def _activate(self):
@@ -477,12 +481,13 @@ class InferenceEngine:
             self.tpot = None
             self.decode_tps = None
         self._gen_count += 1
+        events = [("inference/ttft_ms", self.ttft * 1e3, self._gen_count)]
+        if self.tpot is not None:
+            events += [("inference/tpot_ms", self.tpot * 1e3, self._gen_count),
+                       ("inference/decode_tokens_per_sec", self.decode_tps,
+                        self._gen_count)]
+        obs_record_events(events)        # registry: independent of monitor
         if self._monitor is not None and getattr(self._monitor, "enabled", False):
-            events = [("inference/ttft_ms", self.ttft * 1e3, self._gen_count)]
-            if self.tpot is not None:
-                events += [("inference/tpot_ms", self.tpot * 1e3, self._gen_count),
-                           ("inference/decode_tokens_per_sec", self.decode_tps,
-                            self._gen_count)]
             self._monitor.write_events(events)
         return np.concatenate([ids, gen], axis=1)
 

@@ -36,7 +36,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.causal_lm import init_cache
+from ...observability import profiler as obs_profiler
+from ...observability.trace import get_tracer
 from ...utils.fault_injection import fault_point
+from ...utils.nvtx import annotate
 from ..decode_fns import (build_decode_chunk, build_prefill,
                           build_prefix_prefill, make_slot_select_fn)
 from .kv_pool import SlotKVPool
@@ -224,8 +227,8 @@ class ChunkedDecodeExecutor:
 
     # -------------------------------------------------------------------- steps
     def prefill_into_slot(self, slot: int, prompt: np.ndarray, seed: int = 0,
-                          prefix_len: int = 0, prefix_slab=None
-                          ) -> Tuple[int, float]:
+                          prefix_len: int = 0, prefix_slab=None,
+                          trace_ctx=None) -> Tuple[int, float]:
         """Prefill ``prompt`` (1-D int tokens) and scatter its KV into ``slot``.
 
         With ``prefix_len > 0`` (prefix-cache hit): restore ``prefix_slab``
@@ -242,6 +245,7 @@ class ChunkedDecodeExecutor:
         """
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         t = prompt.shape[0]
+        tracer = get_tracer()
         self.engine._activate()
         if prefix_len:
             if not 0 < prefix_len < t:
@@ -253,32 +257,52 @@ class ChunkedDecodeExecutor:
             ids[0, :suffix.size] = suffix
             fn = self._suffix_prefill_fn(bucket)
             t0 = time.perf_counter()
-            self.pool.restore_prefix(slot, prefix_slab)
+            tr0 = time.monotonic()
+            with annotate("serving.restore_prefix"):
+                self.pool.restore_prefix(slot, prefix_slab)
+            tracer.record_span("restore_prefix", trace_ctx, tr0,
+                               time.monotonic(),
+                               attrs={"slot": slot,
+                                      "prefix_len": int(prefix_len)})
             fault_point("serving.prefix_restore")
             if self._restore_kill is not None:
                 cb, self._restore_kill = self._restore_kill, None
                 cb()
                 raise RuntimeError("chaos: replica killed between prefix "
                                    "restore and suffix prefill")
-            tok0, caches = fn(self.engine.params, self.pool.caches,
-                              np.int32(slot), jnp.asarray(ids),
-                              jnp.asarray([prefix_len], jnp.int32),
-                              jnp.asarray([suffix.size], jnp.int32),
-                              jnp.asarray([seed], jnp.int32), self._base_key)
-            self.pool.caches = caches
-            tok0 = int(np.asarray(tok0)[0, 0])          # host sync: honest TTFT
+            ts0 = time.monotonic()
+            with annotate("serving.suffix_prefill"):
+                tok0, caches = fn(self.engine.params, self.pool.caches,
+                                  np.int32(slot), jnp.asarray(ids),
+                                  jnp.asarray([prefix_len], jnp.int32),
+                                  jnp.asarray([suffix.size], jnp.int32),
+                                  jnp.asarray([seed], jnp.int32),
+                                  self._base_key)
+                self.pool.caches = caches
+                tok0 = int(np.asarray(tok0)[0, 0])      # host sync: honest TTFT
+            tracer.record_span("suffix_prefill", trace_ctx, ts0,
+                               time.monotonic(),
+                               attrs={"bucket": bucket,
+                                      "suffix_tokens": int(suffix.size)})
+            obs_profiler.tick("prefill")
             return tok0, time.perf_counter() - t0
         bucket = self.bucket_for(t)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :t] = prompt
         fn = self._prefill_fn(bucket)
         t0 = time.perf_counter()
-        tok0, one_caches = fn(self.engine.params, jnp.asarray(ids),
-                              jnp.asarray([t], jnp.int32),
-                              jnp.asarray([seed], jnp.int32), self._base_key)
-        tok0 = int(np.asarray(tok0)[0, 0])              # host sync: honest TTFT
+        tb0 = time.monotonic()
+        with annotate("serving.prefill"):
+            tok0, one_caches = fn(self.engine.params, jnp.asarray(ids),
+                                  jnp.asarray([t], jnp.int32),
+                                  jnp.asarray([seed], jnp.int32),
+                                  self._base_key)
+            tok0 = int(np.asarray(tok0)[0, 0])          # host sync: honest TTFT
+        tracer.record_span("bucket_prefill", trace_ctx, tb0, time.monotonic(),
+                           attrs={"bucket": bucket, "prompt_tokens": int(t)})
         dt = time.perf_counter() - t0
         self.pool.scatter_prefill(slot, one_caches)
+        obs_profiler.tick("prefill")
         return tok0, dt
 
     def run_chunk(self, toks: np.ndarray, lens: np.ndarray, active: np.ndarray,
@@ -313,11 +337,12 @@ class ChunkedDecodeExecutor:
             if self._stall_next > 0:
                 stall, self._stall_next = self._stall_next, 0.0
                 time.sleep(stall)
-            buf, toks_d, caches, lens_d, active_d, remaining_d, steps_d = \
-                fn(*args)
-            host = (np.asarray(buf), np.asarray(toks_d), np.asarray(lens_d),
-                    np.asarray(active_d), np.asarray(remaining_d),
-                    np.asarray(steps_d))
+            with annotate("serving.decode_chunk"):
+                buf, toks_d, caches, lens_d, active_d, remaining_d, steps_d = \
+                    fn(*args)
+                host = (np.asarray(buf), np.asarray(toks_d),
+                        np.asarray(lens_d), np.asarray(active_d),
+                        np.asarray(remaining_d), np.asarray(steps_d))
             return host, caches
 
         if self.chunk_deadline_s is None:
@@ -346,6 +371,7 @@ class ChunkedDecodeExecutor:
                 raise box["exc"]
             host, caches = box["out"]
         self._warm_chunk = True
+        obs_profiler.tick("decode_chunk")
         self.pool.caches = caches
         buf, toks_d, lens_d, active_d, remaining_d, steps_d = host
         return ChunkResult(buf=buf, toks=toks_d, lens=lens_d, active=active_d,

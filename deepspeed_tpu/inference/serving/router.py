@@ -53,6 +53,8 @@ from typing import Deque, Dict, List, Optional, Set
 
 import numpy as np
 
+from ...observability.metrics import Histogram, RegistryFeed
+from ...observability.trace import CAT_ROUTER, get_tracer
 from ...utils.fault_injection import fault_point, retry_with_backoff
 from ...utils.logging import logger
 from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
@@ -135,6 +137,9 @@ class RouterRequest:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     _cancel: bool = False
+    _root_span: Optional[object] = None       # request-scoped trace root
+    _attempt_span: Optional[object] = None    # current dispatch attempt
+    _prev_attempt_id: Optional[str] = None    # link target for retry spans
 
     def cancel(self) -> None:
         self._cancel = True
@@ -302,11 +307,16 @@ class RouterTelemetry:
         self.evicted = 0
         self.dispatched: Dict[int, int] = {i: 0 for i in range(n_replicas)}
         self.transitions: List = []       # (tick, replica, old, new)
-        self.ttfts: List[float] = []
-        self.tpots: List[float] = []
+        # bounded distributions (same O(1)-memory contract as ServingTelemetry)
+        self.ttft_ms = Histogram()
+        self.tpot_ms = Histogram()
+        # per-emitter feed: cumulative *_total counters contribute deltas so
+        # successive routers in one process sum in /metrics
+        self._feed = RegistryFeed()
         self.drain_s: Optional[float] = None
 
     def _write(self, events):
+        self._feed.record_events(events)   # process registry (/metrics)
         if self.monitor is not None and getattr(self.monitor, "enabled", False):
             self.monitor.write_events(events)
 
@@ -367,16 +377,12 @@ class RouterTelemetry:
         self._finished_idx += 1
         ev = []
         if rr.ttft is not None:
-            self.ttfts.append(rr.ttft)
+            self.ttft_ms.observe(rr.ttft * 1e3)
             ev.append(("router/ttft_ms", rr.ttft * 1e3, self._finished_idx))
         if rr.tpot is not None:
-            self.tpots.append(rr.tpot)
+            self.tpot_ms.observe(rr.tpot * 1e3)
             ev.append(("router/tpot_ms", rr.tpot * 1e3, self._finished_idx))
         self._write(ev)
-
-    @staticmethod
-    def _pct(xs: List[float], q: float) -> Optional[float]:
-        return float(np.percentile(np.asarray(xs), q)) if xs else None
 
     def snapshot(self) -> Dict:
         # "lost" is the no-silent-loss invariant: every admitted request must
@@ -398,10 +404,10 @@ class RouterTelemetry:
             "lost": lost,
             "dispatched": dict(self.dispatched),
             "drain_ms": None if self.drain_s is None else self.drain_s * 1e3,
-            "ttft_ms_p50": self._pct([x * 1e3 for x in self.ttfts], 50),
-            "ttft_ms_p95": self._pct([x * 1e3 for x in self.ttfts], 95),
-            "ttft_ms_p99": self._pct([x * 1e3 for x in self.ttfts], 99),
-            "tpot_ms_p50": self._pct([x * 1e3 for x in self.tpots], 50),
+            "ttft_ms_p50": self.ttft_ms.percentile(50),
+            "ttft_ms_p95": self.ttft_ms.percentile(95),
+            "ttft_ms_p99": self.ttft_ms.percentile(99),
+            "tpot_ms_p50": self.tpot_ms.percentile(50),
             "tokens_total": 0,  # filled by Router.snapshot with replica sums
         }
 
@@ -430,6 +436,7 @@ class Router:
         self._draining = False
         self._drain_started: Optional[float] = None
         self._prev_sigterm = None
+        self._tracer = get_tracer()
 
     # ---------------------------------------------------------------- frontend
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -451,6 +458,10 @@ class Router:
                            max_new_tokens=max_new, eos_token_id=eos_token_id,
                            deadline_s=deadline_s, seed=int(seed),
                            session=session, arrival=time.monotonic())
+        rr._root_span = self._tracer.begin(
+            "request", cat=CAT_ROUTER, t0=rr.arrival, tid="router",
+            attrs={"request_id": rr.id, "prompt_tokens": int(prompt.size),
+                   **({"session": session} if session is not None else {})})
         self.queue.append(rr)
         self.requests.append(rr)
         self.telemetry.submitted += 1
@@ -585,8 +596,25 @@ class Router:
             rr.state = RouterRequestState.HANDED_OFF
             rr.finish_reason = "drain"
             rr.finished_at = now
+            # hand-off bypasses _finalize: commit the open spans here or the
+            # drained requests' root/attempt lanes vanish from the trace
+            if rr._attempt_span is not None:
+                self._tracer.end_span(rr._attempt_span, t1=now,
+                                      attrs={"outcome": "handed_off"})
+                rr._attempt_span = None
+            if rr._root_span is not None:
+                self._tracer.end_span(
+                    rr._root_span, t1=now,
+                    attrs={"state": "handed_off", "reason": "drain",
+                           "tokens": len(rr.tokens)})
+                rr._root_span = None
             specs.append(rr.handoff_spec())
         self.telemetry.on_drain(now - t0, len(specs))
+        # a drained router is about to exit: the monitor backends' tail events
+        # (csv/jsonl buffers) must be durable before the process goes away
+        m = self.telemetry.monitor
+        if m is not None and hasattr(m, "flush"):
+            m.flush()
         logger.info(f"[router] drain complete in {(now - t0) * 1e3:.1f} ms: "
                     f"{len(specs)} request(s) handed off")
         return specs
@@ -726,23 +754,38 @@ class Router:
             prompt = np.concatenate(
                 [rr.prompt, np.asarray(rr.tokens, np.int32)]) \
                 if rr.tokens else rr.prompt
+            # dispatch-attempt span: retries show as LINKED spans on the same
+            # trace id — the retry replica id + the evicted attempt's span id
+            # ride the attrs, so a killed request's original and retry lanes
+            # join in one Perfetto query
+            att = self._tracer.start_span(
+                "attempt", parent=rr._root_span, cat=CAT_ROUTER,
+                attrs={"replica": target.id, "attempt": rr.attempts + 1,
+                       "prefix_tokens": len(rr.tokens),
+                       **({"retry": True, "retry_replica_id": target.id,
+                           "retry_of": rr._prev_attempt_id}
+                          if rr.retried else {})})
+            att_ctx = att.ctx if att is not None else None
 
-            def attempt(t=target, p=prompt, r=rr, d=deadline):
+            def attempt(t=target, p=prompt, r=rr, d=deadline, c=att_ctx):
                 fault_point("serving.router.dispatch")
                 return t.submit(p, max_new_tokens=r.remaining_budget,
                                 eos_token_id=r.eos_token_id, deadline_s=d,
-                                seed=r.seed)
+                                seed=r.seed, trace_ctx=c)
 
             try:
                 inner = retry_with_backoff(attempt,
                                            retries=cfg.dispatch_retries,
                                            base_delay=cfg.retry_base_delay)
             except QueueFullError:
+                self._tracer.end_span(att, attrs={"outcome": "queue_full"})
                 continue                   # replica raced full; try next tick
             except Exception as e:
                 logger.warning(f"[router] dispatch of request {rr.id} to "
                                f"replica {target.id} failed: "
                                f"{type(e).__name__}: {e}")
+                self._tracer.end_span(att, attrs={"outcome": "dispatch_error",
+                                                  "error": type(e).__name__})
                 rr.excluded.add(target.id)
                 self._health_failure(target.id, now)
                 continue
@@ -751,6 +794,7 @@ class Router:
             rr.attempts += 1
             rr.replica_id = target.id
             rr.inner = inner
+            rr._attempt_span = att
             if rr._cancel:                 # cancel landed between ticks
                 inner.cancel()
             self._dispatched[target.id].append(rr)
@@ -785,6 +829,15 @@ class Router:
                 rr.ttft = rr.first_token_at - rr.arrival
                 rr.prefix_hit_tokens = getattr(rr.inner, "prefix_hit_tokens",
                                                0)
+            inner_span = getattr(rr.inner, "_span", None)
+            if inner_span is not None:
+                # a killed replica never finalizes its handle: the scheduler-
+                # side request span would stay open forever — close it here so
+                # the original replica's lane is complete in the trace
+                self._tracer.end_span(
+                    inner_span, attrs={"state": "abandoned",
+                                       "reason": "absorbed-by-router"})
+                rr.inner._span = None
             rr.inner = None
 
     def _harvest(self, now: float) -> None:
@@ -828,6 +881,12 @@ class Router:
     def _requeue(self, rr: RouterRequest, replica_id: int, now: float,
                  breaker: bool) -> None:
         self._absorb_prefix(rr)
+        if rr._attempt_span is not None:
+            rr._prev_attempt_id = rr._attempt_span.span_id
+            self._tracer.end_span(rr._attempt_span,
+                                  attrs={"outcome": "evicted",
+                                         "evicted_from_replica": replica_id})
+            rr._attempt_span = None
         rr.evictions += 1
         rr.excluded.add(replica_id)
         self.telemetry.on_evicted()
@@ -874,4 +933,15 @@ class Router:
         if (rr.first_token_at is not None and len(rr.tokens) > 1
                 and now > rr.first_token_at):
             rr.tpot = (now - rr.first_token_at) / (len(rr.tokens) - 1)
+        if rr._attempt_span is not None:
+            self._tracer.end_span(rr._attempt_span, t1=now,
+                                  attrs={"outcome": state.value})
+            rr._attempt_span = None
+        if rr._root_span is not None:
+            self._tracer.end_span(
+                rr._root_span, t1=now,
+                attrs={"state": state.value, "reason": reason,
+                       "tokens": len(rr.tokens), "attempts": rr.attempts,
+                       "retried": rr.retried})
+            rr._root_span = None
         self.telemetry.on_finished(rr)

@@ -42,6 +42,7 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
+from ...observability.trace import CAT_SERVING, get_tracer
 from ...utils.fault_injection import fault_point, retry_with_backoff
 from ...utils.logging import logger
 from .executor import ChunkedDecodeExecutor
@@ -128,6 +129,11 @@ class RequestHandle:
     prefix_hit_tokens: int = 0          # prefill tokens skipped via the
     #   prefix cache (0 = cold miss); loadgen splits TTFT on this
     _cancel: bool = False
+    _span: Optional[object] = None      # request-scoped trace root (OpenSpan)
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self._span.trace_id if self._span is not None else None
 
     def cancel(self) -> None:
         self._cancel = True
@@ -160,6 +166,7 @@ class ContinuousBatchingScheduler:
             chunk_deadline_s=cfg.chunk_deadline_s)
         self.cap = cap
         self.telemetry = ServingTelemetry(monitor)
+        self._tracer = get_tracer()
         self.prefix_cache: Optional[PrefixCache] = None
         if cfg.prefix_cache is not None and cfg.prefix_cache.enabled:
             self.prefix_cache = PrefixCache(cfg.prefix_cache)
@@ -178,10 +185,16 @@ class ContinuousBatchingScheduler:
     # ---------------------------------------------------------------- frontend
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
-               deadline_s: Optional[float] = None, seed: int = 0
-               ) -> RequestHandle:
+               deadline_s: Optional[float] = None, seed: int = 0,
+               trace_ctx=None) -> RequestHandle:
         """Enqueue a request. Raises ``ValueError`` on inadmissible shapes and
-        :class:`QueueFullError` (with ``retry_after``) under backpressure."""
+        :class:`QueueFullError` (with ``retry_after``) under backpressure.
+
+        ``trace_ctx`` (an ``observability.trace.SpanContext`` or ``None``)
+        joins this request's spans to a propagated parent trace — the router
+        passes its dispatch-attempt context here, and the subprocess replica
+        deserializes one off the JSONL pipe, so replica-side spans land on the
+        same trace id as the frontend's."""
         prompt, max_new = validate_admission(
             prompt, max_new_tokens, self.config.default_max_new_tokens,
             self.executor.max_prompt_len, self.cap)
@@ -192,6 +205,11 @@ class ContinuousBatchingScheduler:
             id=next(self._ids), prompt=prompt, max_new_tokens=max_new,
             eos_token_id=eos_token_id, deadline_s=deadline_s, seed=int(seed),
             arrival=time.monotonic())
+        handle._span = self._tracer.begin(
+            "replica_request", cat=CAT_SERVING, ctx=trace_ctx,
+            t0=handle.arrival,
+            attrs={"request_id": handle.id, "prompt_tokens": int(prompt.size),
+                   "max_new_tokens": max_new})
         self.queue.append(handle)
         return handle
 
@@ -360,26 +378,43 @@ class ContinuousBatchingScheduler:
     def _admit(self) -> bool:
         admitted = False
         cfg = self.config
+        tracer = self._tracer
         while self.queue and self.executor.pool.free_slots > 0:
             handle = self.queue.popleft()
             slot = self.executor.pool.acquire()
+            admit_t = time.monotonic()
+            tracer.record_span("queue_wait", handle._span,
+                               handle.arrival, admit_t)
             matched, entry = 0, None
             if self.prefix_cache is not None:
+                t_lk = time.monotonic()
                 matched, entry = self.prefix_cache.lookup(handle.prompt)
+                tracer.record_span("prefix_lookup", handle._span, t_lk,
+                                   time.monotonic(),
+                                   attrs={"hit": entry is not None,
+                                          "matched_tokens": int(matched)})
 
             def attempt(h=handle, s=slot, m=matched, e=entry):
                 fault_point("serving.prefill")
                 if e is not None:
                     return self.executor.prefill_into_slot(
                         s, h.prompt, h.seed, prefix_len=m,
-                        prefix_slab=e.slab)
-                return self.executor.prefill_into_slot(s, h.prompt, h.seed)
+                        prefix_slab=e.slab, trace_ctx=h._span)
+                return self.executor.prefill_into_slot(s, h.prompt, h.seed,
+                                                       trace_ctx=h._span)
 
+            prefill_span = tracer.start_span(
+                "prefill", parent=handle._span,
+                attrs={"slot": slot, "prefix_len": int(matched)
+                       if entry is not None else 0})
             try:
                 tok0, _ = retry_with_backoff(attempt,
                                              retries=cfg.transient_retries,
                                              base_delay=cfg.retry_base_delay)
             except Exception as e:
+                tracer.end_span(prefill_span,
+                                attrs={"outcome": "error",
+                                       "error": type(e).__name__})
                 # retry budget exhausted: fail THIS request, keep serving — the
                 # slot must not leak and the loop must not die with the queue
                 # still holding live requests
@@ -414,6 +449,9 @@ class ContinuousBatchingScheduler:
                     self._release(slot)
                 continue
             now = time.monotonic()
+            tracer.end_span(prefill_span, t1=now,
+                            attrs={"outcome": "ok",
+                                   "prefix_hit": entry is not None})
             handle.state = RequestState.RUNNING
             handle.slot = slot
             handle.tokens.append(int(tok0))
@@ -483,11 +521,22 @@ class ContinuousBatchingScheduler:
         now = time.monotonic()
         counts = res.steps - steps_before
         total = 0
+        chunk_t0 = now - res.elapsed
+        chunk_idx = self.telemetry._chunk_idx + 1
         for slot, h in enumerate(self._slot_req):
             if h is None or counts[slot] <= 0:
                 continue
             h.tokens.extend(res.buf[slot, :counts[slot]].tolist())
             total += int(counts[slot])
+            # one span per participating request: the chunk is a batch-level
+            # dispatch, but "where did THIS request's time go" needs it on the
+            # request's own trace. Guarded: tracing-off must not build attrs
+            # dicts on the hottest loop.
+            if h._span is not None:
+                self._tracer.record_span(
+                    "decode_chunk", h._span, chunk_t0, now,
+                    attrs={"chunk": chunk_idx, "slot": slot,
+                           "tokens": int(counts[slot])})
         was_active = self._active.copy()
         self._toks = res.toks[:, 0].copy()
         self._lens = res.lens.copy()
@@ -516,6 +565,15 @@ class ContinuousBatchingScheduler:
         if (handle.first_token_at is not None and len(handle.tokens) > 1
                 and now > handle.first_token_at):
             handle.tpot = (now - handle.first_token_at) / (len(handle.tokens) - 1)
+        if handle._span is not None:
+            self._tracer.instant("retire", handle._span,
+                                 attrs={"state": state.value,
+                                        "reason": reason})
+            self._tracer.end_span(
+                handle._span, t1=now,
+                attrs={"state": state.value, "reason": reason,
+                       "tokens": len(handle.tokens)})
+            handle._span = None
         self.telemetry.on_finished(handle)
 
     def _release(self, slot: int) -> None:

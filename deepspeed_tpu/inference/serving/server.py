@@ -22,6 +22,18 @@ a ``DS_TPU_FAULT_SPEC`` env (``utils.fault_injection.fault_env``) is armed at
 startup — the hook chaos tests use to inject deterministically into
 subprocess-hosted serve processes. Metrics go to the jsonl monitor backend when
 ``--jsonl-metrics DIR`` is given.
+
+Observability (PR 10, ``docs/OBSERVABILITY.md``):
+
+- ``--metrics-port P`` serves Prometheus text exposition at
+  ``http://127.0.0.1:P/metrics`` from the process metrics registry (the same
+  counters the BENCH JSON reports);
+- ``--trace-out FILE`` enables the request-scoped span tracer and writes a
+  Perfetto-loadable Chrome trace on exit (``FILE.jsonl`` alongside it when the
+  path ends in ``.json``... pass a ``.jsonl`` path to stream spans instead);
+- ``--profile-dir DIR [--profile-steps N]`` arms on-demand XLA profiler
+  capture: ``kill -USR2 <pid>`` captures the next N decode chunks/prefills to
+  DIR (TensorBoard/Perfetto-loadable device trace).
 """
 
 import argparse
@@ -257,6 +269,16 @@ def main(argv=None) -> int:
                     help="minimum matched tokens for a cache hit")
     ap.add_argument("--jsonl-metrics", default=None,
                     help="directory for the jsonl monitor backend")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics exposition on this port")
+    ap.add_argument("--trace-out", default=None,
+                    help="enable request-scoped tracing; write a "
+                         "Perfetto-loadable Chrome trace here on exit")
+    ap.add_argument("--profile-dir", default=None,
+                    help="arm on-demand XLA profiler capture to this logdir "
+                         "(trigger with SIGUSR2)")
+    ap.add_argument("--profile-steps", type=int, default=4,
+                    help="decode chunks/prefills per profiler capture")
     ap.add_argument("--selftest", action="store_true")
     ap.add_argument("--requests", type=int, default=8,
                     help="selftest request count")
@@ -266,6 +288,34 @@ def main(argv=None) -> int:
     # a parent chaos harness (utils.fault_injection.fault_env)
     from ...utils.fault_injection import apply_fault_env
     apply_fault_env()
+
+    # observability spine: tracer / Prometheus exposition / profiler capture
+    from ...observability import (configure_capture, get_tracer,
+                                  start_metrics_server)
+    tracer = None
+    if args.trace_out:
+        tracer = get_tracer().enable(pid_label="deepspeed-serve")
+        if args.trace_out.endswith(".jsonl"):
+            tracer.stream_to(args.trace_out)
+    metrics_server = None
+    if args.metrics_port is not None:
+        metrics_server = start_metrics_server(args.metrics_port)
+        print(json.dumps({"metrics_port": metrics_server.server_port}),
+              file=sys.stderr)
+    if args.profile_dir:
+        configure_capture(args.profile_dir, num_ticks=args.profile_steps)
+
+    def _obs_epilogue():
+        # every exit path (selftest included) must land the trace the user
+        # asked for and release the exposition port
+        if tracer is not None:
+            if not args.trace_out.endswith(".jsonl"):
+                n = tracer.export_chrome(args.trace_out)
+                print(json.dumps({"trace_out": args.trace_out, "spans": n}),
+                      file=sys.stderr)
+            tracer.close_stream()
+        if metrics_server is not None:
+            metrics_server.shutdown()
 
     from .prefix_cache import PrefixCacheConfig
     from .scheduler import ContinuousBatchingScheduler, ServingConfig
@@ -300,6 +350,7 @@ def main(argv=None) -> int:
             ok, snap = _selftest_router(front, engines, args.requests,
                                         args.vocab_size)
             print(json.dumps({"selftest_ok": ok, **snap}))
+            _obs_epilogue()
             return 0 if ok else 1
     else:
         if args.chaos:
@@ -310,9 +361,11 @@ def main(argv=None) -> int:
         if args.selftest:
             ok, snap = _selftest(front, args.requests, args.vocab_size)
             print(json.dumps({"selftest_ok": ok, **snap}))
+            _obs_epilogue()
             return 0 if ok else 1
     snap = _serve_stdin(front, chaos=chaos)
     print(json.dumps(snap), file=sys.stderr)
+    _obs_epilogue()
     return 0
 
 

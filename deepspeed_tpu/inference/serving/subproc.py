@@ -17,10 +17,18 @@ truthful at the instant a SIGKILL lands):
   ``DS_TPU_FAULT_SPEC`` env contract arms seeded fault schedules in the child,
   same as ``deepspeed-serve``);
 - parent → ``{"id": i, "prompt": [...], "max_new_tokens": n, "seed": s,
-  "eos_token_id": e|null}`` submits a request;
+  "eos_token_id": e|null, "trace_id": t|absent, "parent_span": p|absent}``
+  submits a request (``trace_id``/``parent_span`` propagate the parent's
+  span context: the child's tracer joins its spans to that trace, so a
+  subprocess-hosted replica's restore/prefill/decode-chunk spans land on the
+  SAME trace id as the frontend's — the cross-process half of the
+  observability spine);
 - child → ``{"id": i, "tokens": [...], "done": bool, "state": "..."}`` after
   every scheduler step in which request ``i`` gained tokens (cumulative
   prefix, not a delta — idempotent under lost/duplicated reads);
+- child → ``{"spans": [...]}`` whenever traced spans finished since the last
+  step (each span dict is ``observability.trace`` wire format; the parent
+  ingests them into its own tracer under a ``subproc<pid>`` lane);
 - parent → ``{"cmd": "stop"}`` (or EOF) drains and exits 0.
 
 Determinism contract: the child builds its engine with the same fixed init
@@ -39,6 +47,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 
@@ -57,6 +66,7 @@ def child_main(argv=None) -> int:
 
     import jax.numpy as jnp
 
+    from ...observability.trace import SpanContext, get_tracer
     from ...utils.fault_injection import apply_fault_env
     from ..config import DeepSpeedInferenceConfig
     from ..engine import InferenceEngine
@@ -96,6 +106,7 @@ def child_main(argv=None) -> int:
         eof.set()
 
     threading.Thread(target=reader, daemon=True).start()
+    tracer = get_tracer()
     handles: Dict[int, object] = {}
     reported: Dict[int, int] = {}
     stop = False
@@ -105,10 +116,18 @@ def child_main(argv=None) -> int:
             if req.get("cmd") == "stop":
                 stop = True
                 continue
+            ctx = None
+            if req.get("trace_id"):
+                # parent propagated a span context: join its trace (enabling
+                # lazily keeps the un-traced soak at zero cost)
+                if not tracer.enabled:
+                    tracer.enable(pid_label=f"subproc{os.getpid()}")
+                ctx = SpanContext(str(req["trace_id"]),
+                                  str(req.get("parent_span") or ""))
             h = sched.submit(req["prompt"],
                              max_new_tokens=req.get("max_new_tokens"),
                              eos_token_id=req.get("eos_token_id"),
-                             seed=req.get("seed", 0))
+                             seed=req.get("seed", 0), trace_ctx=ctx)
             handles[int(req["id"])] = h
         if eof.is_set():
             stop = True
@@ -125,6 +144,11 @@ def child_main(argv=None) -> int:
                       "prefix_hit_tokens": h.prefix_hit_tokens})
                 if h.done:
                     del handles[rid]
+        if tracer.enabled:
+            finished = tracer.drain()
+            if finished:
+                # every line flushed: spans streamed BEFORE any SIGKILL lands
+                emit({"spans": finished})
     emit({"summary": sched.telemetry.snapshot()})
     return 0
 
@@ -155,6 +179,11 @@ class SubprocessReplica:
             stderr=subprocess.DEVNULL)
         self.ready: Optional[Dict] = None
         self.progress: Dict[int, Dict] = {}      # id -> last streamed line
+        # child-side finished spans: bounded drop-oldest, same contract as
+        # the tracer's own ring — a traced soak must not grow a Python list
+        # forever on the parent
+        self.spans: "deque" = deque(maxlen=200_000)
+        self.spans_dropped = 0
         self.summary: Optional[Dict] = None
         self._lock = threading.Lock()
         self._reader = threading.Thread(target=self._pump, daemon=True)
@@ -171,6 +200,12 @@ class SubprocessReplica:
                     self.ready = obj
                 elif "summary" in obj:
                     self.summary = obj["summary"]
+                elif "spans" in obj:
+                    overflow = (len(self.spans) + len(obj["spans"])
+                                - self.spans.maxlen)
+                    if overflow > 0:
+                        self.spans_dropped += overflow
+                    self.spans.extend(obj["spans"])
                 elif "id" in obj:
                     self.progress[int(obj["id"])] = obj
 
@@ -186,12 +221,25 @@ class SubprocessReplica:
         raise TimeoutError("subprocess replica never became ready")
 
     def submit(self, rid: int, prompt, max_new_tokens: int, seed: int = 0,
-               eos_token_id: Optional[int] = None) -> None:
-        self.proc.stdin.write(json.dumps(
-            {"id": int(rid), "prompt": [int(t) for t in prompt],
-             "max_new_tokens": int(max_new_tokens), "seed": int(seed),
-             "eos_token_id": eos_token_id}) + "\n")
+               eos_token_id: Optional[int] = None,
+               trace_id: Optional[str] = None,
+               parent_span: Optional[str] = None) -> None:
+        req = {"id": int(rid), "prompt": [int(t) for t in prompt],
+               "max_new_tokens": int(max_new_tokens), "seed": int(seed),
+               "eos_token_id": eos_token_id}
+        if trace_id:
+            req["trace_id"] = trace_id
+            req["parent_span"] = parent_span
+        self.proc.stdin.write(json.dumps(req) + "\n")
         self.proc.stdin.flush()
+
+    def take_spans(self) -> List[Dict]:
+        """Child-side spans streamed so far (drained); ingest into the parent
+        tracer to join the cross-process trace."""
+        with self._lock:
+            out = list(self.spans)
+            self.spans.clear()
+        return out
 
     def tokens(self, rid: int) -> List[int]:
         """The streamed prefix — all the parent may know about a request."""

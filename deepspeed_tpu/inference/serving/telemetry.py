@@ -1,8 +1,11 @@
 """Serving telemetry: per-request TTFT/TPOT, queue depth, slot occupancy,
-tokens/sec — emitted as ``MonitorMaster`` events (any enabled backend: csv,
-tensorboard, wandb, jsonl) and aggregated for the load-generator's BENCH JSON.
+tokens/sec — recorded into the process-wide observability registry
+(``observability.metrics``: bounded instruments, Prometheus exposition),
+emitted as ``MonitorMaster`` events (any enabled backend: csv, tensorboard,
+wandb, jsonl) and aggregated for the load-generator's BENCH JSON.
 
-Event tags (step semantics in parentheses):
+Event tags are declared once in ``observability.schema`` (step semantics in
+parentheses):
 
 - ``serving/ttft_ms``, ``serving/tpot_ms`` — per finished request (completion idx);
 - ``serving/tokens_per_sec`` — per decode chunk (chunk idx);
@@ -12,12 +15,17 @@ Event tags (step semantics in parentheses):
   ``serving/prefix_evicted_total`` — per scheduler step, prefix cache enabled
   only (hit/miss/inserted/evicted counters + cached-token bytes ride the
   aggregate snapshot).
+
+Latency distributions are **fixed-log-bucket histograms**, not lists: memory
+stays O(1) over a week-long soak (the pre-PR-10 ``ttfts``/``tpots`` Python
+lists grew one float per request forever) while ``snapshot()`` keeps the same
+percentile keys, now bucket-derived.
 """
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict
 
-import numpy as np
+from ...observability.metrics import Histogram, RegistryFeed
 
 
 class ServingTelemetry:
@@ -28,8 +36,15 @@ class ServingTelemetry:
         self._tick = 0
         self._chunk_idx = 0
         self._finished_idx = 0
-        self.ttfts: List[float] = []
-        self.tpots: List[float] = []
+        # per-telemetry bounded histograms (ms): the snapshot's percentile
+        # source. The process registry keeps its own global instruments via
+        # record_events — per-replica snapshots must not blend across replicas.
+        self.ttft_ms = Histogram()
+        self.tpot_ms = Histogram()
+        # per-emitter registry feed: this telemetry's cumulative counters
+        # contribute DELTAS, so N replicas (and successive runs) sum in
+        # /metrics instead of max-merging
+        self._feed = RegistryFeed()
         self.tokens_total = 0
         self.completed = 0
         self.rejected = 0
@@ -47,6 +62,7 @@ class ServingTelemetry:
 
     # ------------------------------------------------------------------- emits
     def _write(self, events):
+        self._feed.record_events(events)   # process registry (/metrics)
         if self.monitor is not None and getattr(self.monitor, "enabled", False):
             self.monitor.write_events(events)
 
@@ -110,20 +126,16 @@ class ServingTelemetry:
         self._finished_idx += 1
         events = []
         if handle.ttft is not None:
-            self.ttfts.append(handle.ttft)
+            self.ttft_ms.observe(handle.ttft * 1e3)
             events.append(("serving/ttft_ms", handle.ttft * 1e3,
                            self._finished_idx))
         if handle.tpot is not None:
-            self.tpots.append(handle.tpot)
+            self.tpot_ms.observe(handle.tpot * 1e3)
             events.append(("serving/tpot_ms", handle.tpot * 1e3,
                            self._finished_idx))
         self._write(events)
 
     # --------------------------------------------------------------- aggregate
-    @staticmethod
-    def _pct(xs: List[float], q: float) -> Optional[float]:
-        return float(np.percentile(np.asarray(xs), q)) if xs else None
-
     def snapshot(self) -> Dict:
         elapsed = time.perf_counter() - self._t_start
         prefix = {}
@@ -151,8 +163,8 @@ class ServingTelemetry:
             "tokens_total": self.tokens_total,
             "tokens_per_sec": (self.tokens_total / self.decode_seconds
                                if self.decode_seconds > 0 else 0.0),
-            "ttft_ms_p50": self._pct([x * 1e3 for x in self.ttfts], 50),
-            "ttft_ms_p95": self._pct([x * 1e3 for x in self.ttfts], 95),
-            "tpot_ms_p50": self._pct([x * 1e3 for x in self.tpots], 50),
-            "tpot_ms_p95": self._pct([x * 1e3 for x in self.tpots], 95),
+            "ttft_ms_p50": self.ttft_ms.percentile(50),
+            "ttft_ms_p95": self.ttft_ms.percentile(95),
+            "tpot_ms_p50": self.tpot_ms.percentile(50),
+            "tpot_ms_p95": self.tpot_ms.percentile(95),
         }

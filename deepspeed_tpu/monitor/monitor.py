@@ -7,6 +7,7 @@ comes from ``jax.process_index`` via the comm facade; values may be device array
 are host-fetched once here, at the monitoring boundary, never in the train step.
 """
 
+import atexit
 import os
 from typing import List, Optional, Tuple
 
@@ -16,12 +17,19 @@ Event = Tuple[str, float, int]
 
 
 class Monitor:
-    """Interface: ``write_events([(tag, value, step), ...])``."""
+    """Interface: ``write_events([(tag, value, step), ...])``; ``flush``/
+    ``close`` default to no-ops so backends opt in."""
 
     enabled = False
 
     def write_events(self, event_list: List[Event]) -> None:
         raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
 
 
 def _rank0() -> bool:
@@ -53,6 +61,16 @@ class TensorBoardMonitor(Monitor):
         for tag, value, step in event_list:
             self.summary_writer.add_scalar(tag, float(value), int(step))
         self.summary_writer.flush()
+
+    def flush(self) -> None:
+        if self.summary_writer is not None:
+            self.summary_writer.flush()
+
+    def close(self) -> None:
+        if self.summary_writer is not None:
+            self.summary_writer.close()
+            self.summary_writer = None
+        self.enabled = False
 
 
 class WandbMonitor(Monitor):
@@ -108,10 +126,15 @@ class csvMonitor(Monitor):
         for tag, value, step in event_list:
             self._file_for(tag).write(f"{int(step)},{float(value)}\n")
 
+    def flush(self):
+        for f in self._files.values():
+            f.flush()
+
     def close(self):
         for f in self._files.values():
             f.close()
         self._files = {}
+        self.enabled = False      # a write after close must not reopen files
 
 
 class jsonlMonitor(Monitor):
@@ -138,6 +161,10 @@ class jsonlMonitor(Monitor):
         for tag, value, step in event_list:
             self._file.write(json.dumps({"tag": tag, "value": float(value),
                                          "step": int(step), "ts": ts}) + "\n")
+
+    def flush(self):
+        if self._file is not None:
+            self._file.flush()
 
     def close(self):
         if self._file is not None:
@@ -166,6 +193,10 @@ class MonitorMaster(Monitor):
                 monitor_config.jsonl_monitor.enabled:
             self.jsonl_monitor = jsonlMonitor(monitor_config.jsonl_monitor)
         self.enabled = any(m is not None and m.enabled for m in self._backends())
+        if self.enabled:
+            # tail events must survive abrupt-but-clean exits: short runs end
+            # before any backend buffer reaches a natural flush point
+            atexit.register(self.close)
 
     def _backends(self):
         return (self.tb_monitor, self.wandb_monitor, self.csv_monitor,
@@ -178,3 +209,17 @@ class MonitorMaster(Monitor):
         for m in self._backends():
             if m is not None and m.enabled:
                 m.write_events(events)
+
+    def flush(self) -> None:
+        for m in self._backends():
+            if m is not None and m.enabled:
+                m.flush()
+
+    def close(self) -> None:
+        """Flush + close every backend (idempotent; also the atexit hook and
+        the router-drain path)."""
+        for m in self._backends():
+            if m is not None:
+                m.close()
+        self.enabled = False
+        atexit.unregister(self.close)

@@ -1,0 +1,28 @@
+"""Unified observability spine (PR 10).
+
+- :mod:`.trace` — request-/step-scoped hierarchical span tracer; Chrome-trace
+  (Perfetto) + JSONL export; cross-process trace-id join over the subprocess
+  serving pipe;
+- :mod:`.metrics` — bounded process-wide registry (counters / gauges /
+  fixed-log-bucket histograms) with ONE declared tag schema, MonitorMaster as
+  an export backend and Prometheus text exposition (``/metrics``);
+- :mod:`.schema` — the declared tag table + the emission-site lint;
+- :mod:`.profiler` — on-demand ``jax.profiler`` capture of N steps/chunks,
+  armed by config or ``SIGUSR2``.
+"""
+
+from . import schema
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry, record_events, start_metrics_server)
+from .profiler import ProfilerCapture, configure_capture, get_capture
+from .profiler import tick as profiler_tick
+from .trace import (CAT_ROUTER, CAT_SERVING, CAT_TRAIN, OpenSpan, SpanContext,
+                    Tracer, get_tracer)
+
+__all__ = [
+    "schema", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "record_events", "start_metrics_server",
+    "ProfilerCapture", "configure_capture", "get_capture", "profiler_tick",
+    "CAT_ROUTER", "CAT_SERVING", "CAT_TRAIN", "OpenSpan", "SpanContext",
+    "Tracer", "get_tracer",
+]

@@ -1,0 +1,216 @@
+"""The ONE declared metric-tag schema.
+
+Every ``write_events`` / registry emission in the tree publishes tags declared
+here — ``serving/*`` (scheduler telemetry), ``router/*`` (multi-replica
+router), ``Train/*`` (training engine + collective spans), ``inference/*``
+(single-call generate + weight-quant audit). The registry consults this table
+for each tag's instrument kind (counter / gauge / histogram) and the tag-lint
+test (``tests/unit/observability``) walks every emission site in the source
+tree and asserts each literal tag resolves to exactly one declaration —
+the guard against the pre-PR-10 drift where ``serving/``, ``router/`` and
+``Train/Comm/`` each invented tag names privately.
+
+Templated tags use ``{i}`` for a per-replica integer segment
+(``router/replica{i}/health`` matches ``router/replica3/health``); emission
+sites that build them with f-strings lint as ``*`` wildcards against the same
+pattern.
+"""
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+COUNTER = "counter"      # cumulative total; emissions carry the running value
+GAUGE = "gauge"          # last-write-wins sampled value
+HISTOGRAM = "histogram"  # per-event observation into fixed log buckets
+
+#: tag pattern -> (kind, help text). THE schema: one entry per published tag.
+TAGS: Dict[str, Tuple[str, str]] = {
+    # ------------------------------------------------- serving (per scheduler)
+    "serving/ttft_ms": (HISTOGRAM, "queue wait + prefill per finished request"),
+    "serving/tpot_ms": (HISTOGRAM, "seconds-per-token (ms) per finished request"),
+    "serving/tokens_per_sec": (GAUGE, "decode throughput per chunk"),
+    "serving/queue_depth": (GAUGE, "admission queue depth per scheduler tick"),
+    "serving/slot_occupancy": (GAUGE, "fraction of KV slots in use per tick"),
+    "serving/completed_total": (COUNTER, "requests finished"),
+    "serving/rejected_total": (COUNTER, "requests rejected (backpressure)"),
+    "serving/prefix_hit_rate": (GAUGE, "admission-level prefix-cache hit rate"),
+    "serving/prefix_cached_bytes": (GAUGE, "resident prefix-slab bytes"),
+    "serving/prefix_evicted_total": (COUNTER, "prefix-cache LRU evictions"),
+    # ------------------------------------------------------------------ router
+    "router/queue_depth": (GAUGE, "router admission queue depth per tick"),
+    "router/retried_total": (COUNTER, "checkpointless retries (re-enqueues)"),
+    "router/evicted_total": (COUNTER, "request evictions (replica death/drain)"),
+    "router/completed_total": (COUNTER, "routed requests finished"),
+    "router/rejected_total": (COUNTER, "routed requests rejected"),
+    "router/handed_off_total": (COUNTER, "requests handed off at drain"),
+    "router/drain_ms": (GAUGE, "graceful-drain wall time"),
+    "router/ttft_ms": (HISTOGRAM, "end-to-end TTFT across retry attempts"),
+    "router/tpot_ms": (HISTOGRAM, "end-to-end TPOT across retry attempts"),
+    "router/replica{i}/health": (GAUGE, "replica state code (0 live .. 3 recovering)"),
+    "router/replica{i}/outstanding": (GAUGE, "running + queued at the replica"),
+    "router/replica{i}/prefix_hit_rate": (GAUGE, "per-replica prefix hit rate"),
+    # ---------------------------------------------------------------- training
+    "Train/Samples/train_loss": (GAUGE, "loss at each optimizer step"),
+    "Train/Samples/lr": (GAUGE, "learning rate at each optimizer step"),
+    "Train/Samples/loss_scale": (GAUGE, "fp16 dynamic loss scale"),
+    "Train/Comm/bytes_on_wire": (GAUGE, "modeled collective bytes per step "
+                                        "(trace-time CollectiveSpans)"),
+    "Train/Comm/overlap_ratio": (GAUGE, "fraction of wire bytes moved by "
+                                        "overlap-scheduled collectives"),
+    "Train/step_time_ms": (HISTOGRAM, "host wall time per optimizer step"),
+    "Train/tokens_per_sec": (GAUGE, "global batch tokens / step time"),
+    "Train/mfu": (GAUGE, "modeled model-flops utilization "
+                         "(profiled flops / step time / peak)"),
+    # --------------------------------------------------------------- inference
+    "inference/ttft_ms": (HISTOGRAM, "prefill latency per generate call"),
+    "inference/tpot_ms": (HISTOGRAM, "decode seconds-per-token per generate"),
+    "inference/decode_tokens_per_sec": (GAUGE, "batch-aggregate decode tok/s"),
+    "inference/weight_quant/bits": (GAUGE, "quantized weight width"),
+    "inference/weight_quant/matrices_quantized": (GAUGE, "matrices quantized"),
+    "inference/weight_quant/matrices_kept_fp": (GAUGE, "matrices kept fp"),
+    "inference/weight_quant/modeled_step_bytes": (GAUGE,
+                                                  "modeled weight bytes/step"),
+    "inference/weight_quant/reduction_vs_bf16": (GAUGE,
+                                                 "modeled stream reduction"),
+}
+
+_TEMPLATE_SEG = re.compile(r"\{[A-Za-z_][A-Za-z0-9_]*\}")
+
+
+def _pattern_regex(pattern: str) -> "re.Pattern":
+    parts = _TEMPLATE_SEG.split(pattern)
+    return re.compile(r"\d+".join(re.escape(p) for p in parts) + r"$")
+
+
+_COMPILED: List[Tuple[str, "re.Pattern"]] = [
+    (p, _pattern_regex(p)) for p in TAGS
+]
+
+
+def resolve(tag: str) -> Optional[str]:
+    """The schema pattern a concrete tag matches, or None if undeclared.
+    ``tag`` may itself be a wildcard form (``router/replica*/health``, the
+    lint's rendering of an f-string) — a ``*`` segment matches ``{i}``."""
+    if tag in TAGS:
+        return tag
+    if "*" in tag:
+        want = re.escape(tag).replace(r"\*", r"\{[A-Za-z_][A-Za-z0-9_]*\}")
+        rx = re.compile(want + "$")
+        matches = [p for p in TAGS if rx.match(p)]
+        return matches[0] if len(matches) == 1 else None
+    for pattern, rx in _COMPILED:
+        if rx.match(tag):
+            return pattern
+    return None
+
+
+def kind_of(tag: str) -> str:
+    """Instrument kind for a concrete tag. Raises ``KeyError`` on an
+    undeclared tag — the runtime face of the lint."""
+    pattern = resolve(tag)
+    if pattern is None:
+        raise KeyError(
+            f"metric tag {tag!r} is not declared in observability.schema.TAGS "
+            "— declare it (kind + help) before emitting it")
+    return TAGS[pattern][0]
+
+
+def is_declared(tag: str) -> bool:
+    return resolve(tag) is not None
+
+
+# --------------------------------------------------------------------- linting
+#: modules whose emission sites the tag lint walks (repo-relative paths)
+EMITTER_MODULES = (
+    "deepspeed_tpu/inference/serving/telemetry.py",
+    "deepspeed_tpu/inference/serving/router.py",
+    "deepspeed_tpu/runtime/engine.py",
+    "deepspeed_tpu/inference/engine.py",
+    "deepspeed_tpu/observability/metrics.py",
+)
+
+_EMIT_FUNCS = {"write_events", "record_events", "record", "emit", "_write",
+               "counter", "gauge", "histogram"}
+_TAG_RE = re.compile(r"^(serving|router|Train|inference)/[A-Za-z0-9_{}*./]+$")
+
+
+def _literal_tag(node: ast.AST) -> Optional[str]:
+    """Render a Str/JoinedStr AST node to a tag literal (f-string interpolations
+    become ``*``); None when it isn't tag-shaped."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+    elif isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        text = "".join(parts)
+    else:
+        return None
+    return text if _TAG_RE.match(text) else None
+
+
+def iter_emission_tags(path: str) -> Iterator[Tuple[str, int]]:
+    """Yield ``(tag_literal, lineno)`` for every tag-shaped string that feeds a
+    metric emission in ``path``: any function that calls one of the emit
+    surfaces (``write_events`` / ``record_events`` / registry ``record`` /
+    ``counter``/``gauge``/``histogram``) contributes every tag-shaped string
+    constant in its body (tags are built as ``(tag, value, step)`` tuples or
+    passed directly; both shapes are covered by the string walk)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+
+    def calls_emit(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                fname = None
+                if isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                if fname in _EMIT_FUNCS:
+                    return True
+        return False
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not calls_emit(fn):
+            continue
+        body = fn.body
+        # skip the docstring: prose mentions of tags are not emission sites
+        if (body and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            body = body[1:]
+        for stmt in body:
+            # constants INSIDE an f-string are fragments, not tags: lint the
+            # rendered JoinedStr pattern, never its pieces
+            fragment_ids = set()
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.JoinedStr):
+                    for sub in ast.walk(node):
+                        if sub is not node:
+                            fragment_ids.add(id(sub))
+            for node in ast.walk(stmt):
+                if id(node) in fragment_ids:
+                    continue
+                tag = _literal_tag(node)
+                if tag is not None:
+                    yield tag, node.lineno
+
+
+def lint_emission_sites(repo_root: str) -> List[str]:
+    """Every undeclared tag across :data:`EMITTER_MODULES`, as
+    ``"path:line: tag"`` strings (empty list = clean)."""
+    import os
+    problems = []
+    for rel in EMITTER_MODULES:
+        path = os.path.join(repo_root, rel)
+        for tag, lineno in iter_emission_tags(path):
+            if resolve(tag) is None:
+                problems.append(f"{rel}:{lineno}: {tag}")
+    return problems

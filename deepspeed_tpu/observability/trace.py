@@ -1,0 +1,334 @@
+"""Hierarchical wall-clock span tracer with request-/step-scoped context.
+
+The host-side half of the observability spine: answers "where did this one
+request's 1.9 s go?" by recording every stage of the serving column
+(admit → queue wait → prefix-cache lookup → restore → prefill → decode chunks
+→ retire, with router retry attempts as linked spans carrying the retry
+replica id) and the training step (``train_step`` / ``grad_sync`` /
+``checkpoint_commit``) as spans that share one **trace id per request/step**.
+
+Design constraints, in order:
+
+1. **Disabled is near-zero cost.** The tracer is a process-global that starts
+   disabled; every instrumentation site costs one method call that returns
+   immediately (``begin``/``start_span`` return ``None``, ``span()`` yields a
+   shared null context). No allocation, no clock read.
+2. **Bounded.** Finished spans land in a drop-oldest ring (``max_spans``);
+   drops are counted, never silent.
+3. **Cross-process joinable.** A ``SpanContext`` is two strings
+   (``trace_id``, ``span_id``) that serialize over the ``serving/subproc.py``
+   JSONL pipe; the child's spans carry the parent's trace id and
+   :meth:`Tracer.ingest` merges them into the parent's buffer under the
+   child's pid lane. Timestamps are wall-clock micros (``time.time``-anchored,
+   advanced by the monotonic clock) so lanes from different processes line up.
+
+Exports: Chrome-trace-event JSON (``{"traceEvents": [...]}``; load in
+Perfetto / ``chrome://tracing``) and a JSONL stream (one finished span per
+line) for tailing.
+"""
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# span categories (Chrome "cat" field) — one per subsystem lane
+CAT_SERVING = "serving"
+CAT_ROUTER = "router"
+CAT_TRAIN = "train"
+
+
+class SpanContext:
+    """The cross-boundary identity of a span: what you put on a wire."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @staticmethod
+    def from_dict(d) -> Optional["SpanContext"]:
+        if not d or not d.get("trace_id"):
+            return None
+        return SpanContext(str(d["trace_id"]), str(d.get("span_id", "")))
+
+
+class OpenSpan:
+    """A started-but-unfinished span (kept on the owning handle/engine)."""
+
+    __slots__ = ("name", "cat", "trace_id", "span_id", "parent_id", "t0",
+                 "attrs", "tid")
+
+    def __init__(self, name, cat, trace_id, span_id, parent_id, t0, attrs,
+                 tid):
+        self.name = name
+        self.cat = cat
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = t0
+        self.attrs = attrs or {}
+        self.tid = tid
+
+    @property
+    def ctx(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+
+class _NullCtx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_open")
+
+    def __init__(self, tracer, open_span):
+        self._tracer = tracer
+        self._open = open_span
+
+    def __enter__(self):
+        return self._open
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._open.attrs["error"] = exc_type.__name__
+        self._tracer.end_span(self._open)
+        return False
+
+
+def _parent_of(parent) -> tuple:
+    """(trace_id, span_id) from an OpenSpan / SpanContext / None."""
+    if parent is None:
+        return None, None
+    return parent.trace_id, getattr(parent, "span_id", None)
+
+
+class Tracer:
+    """Process-wide span recorder. ``enable()`` before the run; instrument
+    sites call through unconditionally and pay ~nothing while disabled."""
+
+    def __init__(self, max_spans: int = 200_000):
+        self.enabled = False
+        self.max_spans = int(max_spans)
+        self._spans: "deque[Dict]" = deque(maxlen=self.max_spans)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._pid_label = f"pid{os.getpid()}"
+        self._stream = None
+        # wall-anchored monotonic clock: cross-process lanes align on wall
+        # time, in-process durations stay monotonic
+        self._mono0 = time.monotonic()
+        self._wall0 = time.time()
+
+    # ------------------------------------------------------------------ admin
+    def enable(self, pid_label: Optional[str] = None,
+               max_spans: Optional[int] = None) -> "Tracer":
+        if max_spans is not None and max_spans != self.max_spans:
+            self.max_spans = int(max_spans)
+            with self._lock:
+                self._spans = deque(self._spans, maxlen=self.max_spans)
+        if pid_label:
+            self._pid_label = pid_label
+        self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def stream_to(self, path: str) -> None:
+        """Also append every finished span to ``path`` as one JSON line."""
+        self._stream = open(path, "a", buffering=1)
+
+    def close_stream(self) -> None:
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+
+    # ------------------------------------------------------------------ clock
+    def ts_us(self, mono: Optional[float] = None) -> float:
+        """Wall-anchored timestamp in µs from a ``time.monotonic`` reading."""
+        m = time.monotonic() if mono is None else mono
+        return (self._wall0 + (m - self._mono0)) * 1e6
+
+    # ------------------------------------------------------------------- spans
+    def _new_id(self) -> str:
+        return f"{os.getpid():x}.{next(self._ids):x}"
+
+    def new_trace_id(self) -> str:
+        return f"t{os.getpid():x}.{next(self._ids):x}.{os.urandom(3).hex()}"
+
+    def begin(self, name: str, cat: str = CAT_SERVING,
+              ctx: Optional[SpanContext] = None, attrs: Optional[Dict] = None,
+              t0: Optional[float] = None, tid: Optional[str] = None
+              ) -> Optional[OpenSpan]:
+        """Open a ROOT-scoped span. With ``ctx`` (a propagated parent), the new
+        span joins that trace under that parent; otherwise a fresh trace id is
+        minted — this is the request/step scope boundary."""
+        if not self.enabled:
+            return None
+        trace_id, parent_id = _parent_of(ctx)
+        if trace_id is None:
+            trace_id = self.new_trace_id()
+        return OpenSpan(name, cat, trace_id, self._new_id(), parent_id,
+                        time.monotonic() if t0 is None else t0, attrs,
+                        tid or threading.current_thread().name)
+
+    def start_span(self, name: str, parent=None, cat: Optional[str] = None,
+                   attrs: Optional[Dict] = None, t0: Optional[float] = None,
+                   tid: Optional[str] = None) -> Optional[OpenSpan]:
+        """Open a child span under ``parent`` (OpenSpan or SpanContext)."""
+        if not self.enabled or parent is None:
+            return None
+        trace_id, parent_id = _parent_of(parent)
+        return OpenSpan(name, cat or getattr(parent, "cat", CAT_SERVING),
+                        trace_id, self._new_id(), parent_id,
+                        time.monotonic() if t0 is None else t0, attrs,
+                        tid or threading.current_thread().name)
+
+    def end_span(self, open_span: Optional[OpenSpan],
+                 t1: Optional[float] = None,
+                 attrs: Optional[Dict] = None) -> None:
+        if open_span is None:
+            return
+        if attrs:
+            open_span.attrs.update(attrs)
+        t1 = time.monotonic() if t1 is None else t1
+        self._commit(open_span.name, open_span.cat, open_span.trace_id,
+                     open_span.span_id, open_span.parent_id,
+                     self.ts_us(open_span.t0),
+                     max((t1 - open_span.t0) * 1e6, 0.0),
+                     open_span.attrs, open_span.tid)
+
+    def span(self, name: str, parent=None, cat: str = CAT_SERVING,
+             attrs: Optional[Dict] = None):
+        """Context manager. With ``parent`` the span nests under it; without,
+        it roots a fresh (step-scoped) trace id."""
+        if not self.enabled:
+            return _NULL
+        if parent is not None:
+            return _SpanCtx(self, self.start_span(name, parent, cat, attrs))
+        return _SpanCtx(self, self.begin(name, cat, None, attrs))
+
+    def record_span(self, name: str, parent, t0: float, t1: float,
+                    cat: Optional[str] = None, attrs: Optional[Dict] = None,
+                    tid: Optional[str] = None) -> None:
+        """Retroactive span between two ``time.monotonic`` readings (e.g.
+        queue wait, measured arrival→admit)."""
+        if not self.enabled or parent is None:
+            return
+        trace_id, parent_id = _parent_of(parent)
+        self._commit(name, cat or getattr(parent, "cat", CAT_SERVING),
+                     trace_id, self._new_id(), parent_id, self.ts_us(t0),
+                     max((t1 - t0) * 1e6, 0.0), attrs or {},
+                     tid or threading.current_thread().name)
+
+    def instant(self, name: str, parent, cat: Optional[str] = None,
+                attrs: Optional[Dict] = None) -> None:
+        if not self.enabled or parent is None:
+            return
+        now = time.monotonic()
+        self.record_span(name, parent, now, now, cat=cat, attrs=attrs)
+
+    def _commit(self, name, cat, trace_id, span_id, parent_id, ts, dur,
+                attrs, tid) -> None:
+        span = {"name": name, "cat": cat, "trace_id": trace_id,
+                "span_id": span_id, "parent_id": parent_id, "ts": ts,
+                "dur": dur, "pid": self._pid_label, "tid": tid,
+                "attrs": attrs}
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(span)
+        if self._stream is not None:
+            self._stream.write(json.dumps(span) + "\n")
+
+    # ----------------------------------------------------------- cross-process
+    def ingest(self, spans: List[Dict], pid_label: Optional[str] = None
+               ) -> None:
+        """Merge spans exported by another process (its ``drain()`` output).
+        Works even while this tracer is disabled — the parent may collect a
+        child's spans without tracing itself."""
+        with self._lock:
+            for s in spans:
+                s = dict(s)
+                if pid_label:
+                    s["pid"] = pid_label
+                if len(self._spans) == self._spans.maxlen:
+                    self.dropped += 1
+                self._spans.append(s)
+
+    def drain(self) -> List[Dict]:
+        """Remove and return every finished span (the subprocess streaming
+        path: the child drains after each scheduler step and ships the batch
+        over its stdout pipe)."""
+        with self._lock:
+            out = list(self._spans)
+            self._spans.clear()
+        return out
+
+    @property
+    def spans(self) -> List[Dict]:
+        with self._lock:
+            return list(self._spans)
+
+    # ---------------------------------------------------------------- exports
+    def chrome_events(self) -> List[Dict]:
+        """Chrome trace events ('X' completes + 'M' lane metadata)."""
+        spans = self.spans
+        pids: Dict[str, int] = {}
+        tids: Dict[tuple, int] = {}
+        events: List[Dict] = []
+        for s in spans:
+            pid = pids.setdefault(s["pid"], len(pids) + 1)
+            tkey = (s["pid"], s["tid"])
+            tid = tids.setdefault(tkey, len(tids) + 1)
+            args = {"trace_id": s["trace_id"], "span_id": s["span_id"]}
+            if s.get("parent_id"):
+                args["parent_id"] = s["parent_id"]
+            args.update(s.get("attrs") or {})
+            events.append({"name": s["name"], "cat": s["cat"], "ph": "X",
+                           "ts": s["ts"], "dur": max(s["dur"], 1.0),
+                           "pid": pid, "tid": tid, "args": args})
+        for label, pid in pids.items():
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": label}})
+        for (plabel, tlabel), tid in tids.items():
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pids[plabel], "tid": tid,
+                           "args": {"name": tlabel}})
+        return events
+
+    def export_chrome(self, path: str) -> int:
+        """Write Perfetto-loadable Chrome-trace JSON; returns the span count."""
+        events = self.chrome_events()
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                       "otherData": {"dropped_spans": self.dropped}}, f)
+        return sum(1 for e in events if e["ph"] == "X")
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer

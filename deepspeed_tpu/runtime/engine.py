@@ -21,6 +21,7 @@ with reference training loops; ``train_batch()`` is the fused fast path.
 import os
 import signal
 import threading
+import time
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
@@ -37,11 +38,15 @@ from ..ops.lamb.fused_lamb import fused_lamb
 from ..ops.optimizer import Optimizer, from_optax
 from ..parallel.mesh import (AXIS_DATA, MeshSpec, get_global_mesh,
                              set_global_mesh)
+from ..observability import profiler as obs_profiler
+from ..observability.metrics import record_events as obs_record_events
+from ..observability.trace import CAT_TRAIN, get_tracer
 from ..parallel.overlap import resolve_overlap_config, set_overlap_config
 from ..utils.comms_logging import (collective_spans, record_collective,
                                    spans_overlap_ratio, spans_total_bytes)
 from ..utils.fault_injection import fault_point
 from ..utils.logging import log_dist, logger
+from ..utils.nvtx import annotate
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
                            SynchronizedWallClockTimer, ThroughputTimer, TRAIN_BATCH_TIMER)
 from .checkpoint_engine.checkpoint_engine import (
@@ -63,6 +68,28 @@ class TrainState(NamedTuple):
     scaler: LossScaleState
     global_step: jnp.ndarray
     skipped_steps: jnp.ndarray
+
+
+#: bf16 peak TFLOPS per chip by device kind (for modeled Train/mfu when
+#: ``flops_profiler.peak_tflops`` is unset; unknown kinds — CPU hosts — skip
+#: the mfu event rather than publish a made-up number)
+_PEAK_TFLOPS_BY_KIND = {
+    "tpu v4": 275.0,
+    "tpu v5 lite": 197.0,
+    "tpu v5e": 197.0,
+    "tpu v5p": 459.0,
+    "tpu v6e": 918.0,
+}
+
+
+def _batch_tokens(batch) -> int:
+    """Modeled token count of one global batch: element count of the leading
+    array leaf (the input ids for LM batches; labels/masks share the shape)."""
+    try:
+        leaves = jax.tree_util.tree_leaves(batch)
+        return int(np.prod(np.shape(leaves[0]))) if leaves else 0
+    except Exception:                                  # pragma: no cover
+        return 0
 
 
 class DeepSpeedEngine:
@@ -915,16 +942,38 @@ class DeepSpeedEngine:
         lr = np.float32(self.get_lr_value())
         theta = np.float32(self.progressive_layer_drop.get_theta()
                            if self.progressive_layer_drop is not None else 1.0)
-        if self.offload_enabled:
-            self.state, grads, metrics = jitted(self.state, gbatch, theta)
-            self._host_optimizer_step(grads, lr, metrics)
-        elif self._quantized_dp:
-            self.state, metrics, self._qar_residual = jitted(
-                self.state, gbatch, lr, theta, self._qar_residual)
-        else:
-            self.state, metrics = jitted(self.state, gbatch, lr, theta)
+        tracer = get_tracer()
+        self._step_t0 = time.perf_counter()
+        self._last_step_tokens = _batch_tokens(batch)
+        step_span = tracer.begin("train_step", cat=CAT_TRAIN, tid="train",
+                                 attrs={"step": self._host_steps + 1})
+        with annotate("train_step"):
+            if self.offload_enabled:
+                self.state, grads, metrics = jitted(self.state, gbatch, theta)
+                self._host_optimizer_step(grads, lr, metrics)
+            elif self._quantized_dp:
+                self.state, metrics, self._qar_residual = jitted(
+                    self.state, gbatch, lr, theta, self._qar_residual)
+            else:
+                self.state, metrics = jitted(self.state, gbatch, lr, theta)
         if first_trace:
             self._comm_spans = collective_spans.summary()
+        if step_span is not None:
+            # tracing-enabled mode pays one sync so the span covers the device
+            # work, not just the async dispatch (disabled mode never syncs)
+            jax.block_until_ready(metrics["loss"])
+            # grad sync is XLA-scheduled inside the step: host wall-time can't
+            # split it out, but the trace-time byte accounting can ride the
+            # step's trace as a MODELED child span
+            if spans_total_bytes(self._comm_spans):
+                tracer.instant(
+                    "grad_sync", step_span, cat=CAT_TRAIN,
+                    attrs={"modeled": True,
+                           "bytes_on_wire": spans_total_bytes(self._comm_spans),
+                           "overlap_ratio":
+                               spans_overlap_ratio(self._comm_spans)})
+            tracer.end_span(step_span)
+        obs_profiler.tick("train_step")
         self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=True)
 
@@ -969,9 +1018,19 @@ class DeepSpeedEngine:
             self._run_flops_profiler_offload(micros[0])
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
+        self._step_t0 = time.perf_counter()
+        self._last_step_tokens = _batch_tokens(batch)
         lr = np.float32(self.get_lr_value())
         rng = jax.random.fold_in(self._base_rng, self._host_steps)
-        metrics = self._param_offload.train_step(micros, lr=float(lr), rng=rng)
+        tracer = get_tracer()
+        step_span = tracer.begin("train_step", cat=CAT_TRAIN, tid="train",
+                                 attrs={"step": self._host_steps + 1,
+                                        "offload": True})
+        with annotate("train_step"):
+            metrics = self._param_offload.train_step(micros, lr=float(lr),
+                                                     rng=rng)
+        tracer.end_span(step_span)       # streamed step is host-synchronous
+        obs_profiler.tick("train_step")
         self.timers(TRAIN_BATCH_TIMER).stop(sync=False)
         self.tput_timer.stop(global_step=True)
         self._host_steps += 1
@@ -1204,7 +1263,41 @@ class DeepSpeedEngine:
             self._build_micro_fns()
         return self._fns["eval_step"](self.state.params, gb, rng)
 
+    def set_monitor(self, monitor):
+        """Attach/replace the MonitorMaster at runtime (mirrors
+        ``InferenceEngine.set_monitor``); per-step ``Train/*`` events — loss,
+        lr, step time, tokens/sec, and (when the flops profiler has run)
+        modeled MFU — flow to it and to the observability registry."""
+        self.monitor = monitor
+        return self
+
+    def _modeled_mfu(self, step_time_s: float) -> Optional[float]:
+        """Modeled model-flops utilization: profiled step flops / step wall
+        time / aggregate peak. Needs both a flops-profiler result (run the
+        profiler via ``flops_profiler.profile_step``) and a per-chip peak —
+        ``flops_profiler.peak_tflops`` in config, or the device-kind table
+        for known TPUs. The profiled flops cover the whole GLOBAL-batch step,
+        so the peak is per-chip × device count."""
+        prof = getattr(self, "flops_profiler", None)
+        if prof is None or prof.result is None or step_time_s <= 0:
+            return None
+        peak_tflops = self._config.flops_profiler.peak_tflops
+        if peak_tflops is None:
+            peak_tflops = _PEAK_TFLOPS_BY_KIND.get(
+                jax.devices()[0].device_kind.lower())
+        if not peak_tflops:
+            return None
+        achieved = prof.result.total_flops / step_time_s / 1e12
+        return achieved / (float(peak_tflops) * jax.device_count())
+
     def _write_monitor_events(self, metrics):
+        # Train/* export (monitor AND registry) is gated on an enabled monitor
+        # ON PURPOSE, unlike the inference engine's unconditional registry
+        # records: building these events calls float(loss) — a per-step device
+        # sync that stalls the async dispatch queue. generate() already syncs
+        # for TTFT so its records are free; a monitor-less training loop must
+        # stay fully pipelined. To export Train/* to the registry alone,
+        # attach any cheap backend (jsonl) or engine.set_monitor(...).
         if self.monitor is None or not getattr(self.monitor, "enabled", False):
             return
         step = self._host_steps
@@ -1221,6 +1314,21 @@ class DeepSpeedEngine:
                            float(spans_total_bytes(self._comm_spans)), step))
             events.append(("Train/Comm/overlap_ratio",
                            spans_overlap_ratio(self._comm_spans), step))
+        # step wall time, honest: the float(loss) above already forced the
+        # device sync, so the clock covers the whole step, not the dispatch
+        t0 = getattr(self, "_step_t0", None)
+        if t0 is not None:
+            step_time = time.perf_counter() - t0
+            self._step_t0 = None
+            events.append(("Train/step_time_ms", step_time * 1e3, step))
+            tokens = getattr(self, "_last_step_tokens", 0)
+            if tokens and step_time > 0:
+                events.append(("Train/tokens_per_sec", tokens / step_time,
+                               step))
+            mfu = self._modeled_mfu(step_time)
+            if mfu is not None:
+                events.append(("Train/mfu", mfu, step))
+        obs_record_events(events)        # process registry (exposition)
         self.monitor.write_events(events)
 
     # ------------------------------------------------------------- properties
@@ -1327,6 +1435,11 @@ class DeepSpeedEngine:
         if dist.get_rank() != 0:
             self.checkpoint_engine.commit(tag)
         dist.barrier("ckpt_drain")
+        tracer = get_tracer()
+        commit_span = tracer.begin("checkpoint_commit", cat=CAT_TRAIN,
+                                   tid="train",
+                                   attrs={"tag": str(tag),
+                                          "step": self._host_steps})
         if dist.get_rank() == 0:
             final = self.checkpoint_engine.commit_tag(save_dir, tag)
         else:
@@ -1334,6 +1447,7 @@ class DeepSpeedEngine:
         dist.barrier("ckpt_commit")
         if save_latest and dist.get_rank() == 0:
             write_latest_pointer(save_dir, tag)
+        tracer.end_span(commit_span)
         return final
 
     def _resolve_load_tag(self, load_dir: str, tag: Optional[str]):

@@ -4,6 +4,19 @@ Behavioural equivalent of reference ``deepspeed/utils/nvtx.py`` (``instrument_w_
 and the accelerator ``range_push/range_pop`` surface: on TPU the profiler is XLA's —
 ranges become ``jax.profiler.TraceAnnotation`` named scopes, visible in TensorBoard's
 trace viewer / Perfetto exactly where NVTX ranges land in Nsight.
+
+Two flavours, wired at the PR-10 observability call sites:
+
+- :func:`annotate` — HOST-side ``TraceAnnotation`` around a dispatch (prefill,
+  decode chunk, train step): shows as a named range on the host lane of an
+  XLA-profiler capture, aligning the device timeline with the wall-clock spans
+  ``observability.trace`` records for the same region;
+- :func:`named_scope` — IN-GRAPH ``jax.named_scope`` around traced collectives
+  (``parallel/overlap.py`` rings, quantized allreduce): the name lands in XLA
+  op metadata, so the device ops themselves carry the call-site label.
+
+Both are no-ops cheap enough for hot paths when no profiler is capturing
+(``TraceMe`` checks an atomic; ``named_scope`` only exists at trace time).
 """
 
 import functools
@@ -11,6 +24,16 @@ import threading
 from typing import Callable
 
 import jax
+
+
+def annotate(name: str):
+    """Host-side profiler range (context manager)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def named_scope(name: str):
+    """Trace-time op-metadata scope for in-graph regions (collectives)."""
+    return jax.named_scope(name)
 
 
 def instrument_w_nvtx(func: Callable) -> Callable:

@@ -47,6 +47,11 @@ def pytest_configure(config):
         "markers", "prefix_cache: radix prompt-prefix KV cache lane (trie "
         "semantics, LRU eviction, suffix prefill, hit-vs-miss greedy parity, "
         "restore-boundary chaos, subprocess SIGKILL retry) — tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "observability: tracing/metrics/profiler lane (span "
+        "nesting + Perfetto schema, cross-process trace join, histogram "
+        "percentiles, /metrics exposition, tag-schema lint, overhead A/B "
+        "smoke) — tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -61,17 +66,19 @@ def pytest_collection_modifyitems(config, items):
     def rank(it):
         if "test_fault_tolerance" in it.nodeid:
             return 0
+        if it.get_closest_marker("observability") is not None:
+            return 1                # fast lane: whole suite runs in seconds
         if "inference/serving" in it.nodeid \
                 or it.get_closest_marker("serving_router") is not None \
                 or it.get_closest_marker("prefix_cache") is not None:
-            return 1
-        if it.get_closest_marker("comm_overlap") is not None:
             return 2
-        if it.get_closest_marker("weight_quant") is not None:
+        if it.get_closest_marker("comm_overlap") is not None:
             return 3
-        return 4
+        if it.get_closest_marker("weight_quant") is not None:
+            return 4
+        return 5
 
-    if any(rank(it) < 4 for it in items):
+    if any(rank(it) < 5 for it in items):
         items.sort(key=rank)        # stable: preserves order within each rank
 
 
