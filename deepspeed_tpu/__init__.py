@@ -41,7 +41,8 @@ def initialize(args=None,
     if config is None and args is not None and hasattr(args, "deepspeed_config") \
             and args.deepspeed_config is not None:
         config = args.deepspeed_config
-    assert config is not None, "DeepSpeed requires --deepspeed_config or config="
+    if not (config is not None):
+        raise AssertionError("DeepSpeed requires --deepspeed_config or config=")
 
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine

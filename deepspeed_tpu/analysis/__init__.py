@@ -1,0 +1,48 @@
+"""Program-contract static analysis: jaxpr/HLO lint passes + AST rules.
+
+The codebase carries hard structural invariants that used to live as one-off
+test walks or tribal notes in CHANGES.md — donation discipline on the serving
+hot path, the one-compile-per-key property of the executor caches, dequant
+hoisted out of decode loop bodies, bytes-on-wire accounting that matches the
+program. This package turns each into a reusable, declarative **contract
+pass** over a traced function's jaxpr / optimized HLO (plus an AST rule
+runner for Python-level rules), so every future kernel/serving PR lands
+against machine-checked contracts.
+
+Pass catalog (see ``docs/ANALYSIS.md``):
+
+- :mod:`.donation` — every ``donate_argnums`` buffer is actually aliased in
+  the compiled executable (no silent-copy fallback);
+- :mod:`.retrace` — compile-cache lint: one compile per ``_fns`` key, no
+  weak-type/shape-drift retraces;
+- :mod:`.host_sync` — hot-path host-sync detector (AST + trace-time hybrid);
+- :mod:`.jaxpr_passes` — :func:`assert_loop_invariant`, the generalized
+  dequant-hoist pin: structurally keeps ops out of while/scan bodies;
+- :mod:`.collectives` — bytes-on-wire accounting from the jaxpr, cross-checked
+  against ``CollectiveSpans`` records;
+- :mod:`.ast_rules` — AST rule runner (bare-assert ban, emission-tag schema,
+  hot-path sync rule) shared with ``observability.schema``;
+- :mod:`.sweep` — the ``bin/ds-tpu-lint`` whole-repo sweep over the canonical
+  traces + AST rules, emitting a JSON report.
+"""
+
+from .ast_rules import (AstRule, BareAssertRule, EmissionTagRule,
+                        iter_emission_tags, run_ast_rules)
+from .collectives import collective_accounting, crosscheck_findings
+from .donation import DonationError, assert_all_donated, donation_findings
+from .host_sync import (HOT_PATH_SPECS, HostSyncRule, hot_path_sync_findings,
+                        trace_sync_findings)
+from .jaxpr_passes import (LoopInvarianceError, assert_loop_invariant,
+                           loop_body_findings)
+from .report import Finding, PassResult, Report
+from .retrace import CompileCacheLint, RetraceError, cache_compile_counts
+
+__all__ = [
+    "AstRule", "BareAssertRule", "EmissionTagRule", "iter_emission_tags",
+    "run_ast_rules", "collective_accounting", "crosscheck_findings",
+    "DonationError", "assert_all_donated", "donation_findings",
+    "HOT_PATH_SPECS", "HostSyncRule", "hot_path_sync_findings",
+    "trace_sync_findings", "LoopInvarianceError", "assert_loop_invariant",
+    "loop_body_findings", "Finding", "PassResult", "Report",
+    "CompileCacheLint", "RetraceError", "cache_compile_counts",
+]

@@ -1,0 +1,213 @@
+"""AST rule runner: Python-level lint rules over the library source tree.
+
+One framework for every source-level rule — the bare-``assert`` ban, the
+metric-tag schema lint that used to be a private walker inside
+``observability/schema.py``, and the hot-path host-sync rule
+(:mod:`.host_sync`). Rules are objects with ``name`` and
+``check(tree, source_lines, relpath) -> [Finding]``; :func:`run_ast_rules`
+walks a file set once, parses each file once, and feeds every rule — so
+adding a contract to a future PR is one rule class, not one bespoke walker.
+
+Rule catalog:
+
+- :class:`BareAssertRule` — no bare ``assert`` in library (non-test) code:
+  asserts vanish under ``python -O``, so a guard written as one is a guard
+  that does not exist in optimized deployments (the exact bug class PR 3
+  fixed in ``chunked_matmul_reduce_scatter``). Tests keep their asserts
+  (pytest rewrites them); library code raises explicit exceptions.
+- :class:`EmissionTagRule` — every metric-tag literal that feeds an emission
+  site resolves against the declared schema (``observability.schema.TAGS``).
+"""
+
+import ast
+import fnmatch
+import os
+import re
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+from .report import Finding, PassResult, SEVERITY_ERROR
+
+
+class AstRule:
+    """Base: subclasses set ``name`` and implement :meth:`check`."""
+
+    name = "ast-rule"
+
+    def check(self, tree: ast.Module, source_lines: List[str],
+              relpath: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- bare assert
+class BareAssertRule(AstRule):
+    """Ban ``assert`` statements in library code paths."""
+
+    name = "bare_assert"
+
+    def check(self, tree, source_lines, relpath):
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assert):
+                findings.append(Finding(
+                    self.name, SEVERITY_ERROR, f"{relpath}:{node.lineno}",
+                    "bare assert in library code — vanishes under python -O; "
+                    "raise an explicit exception instead",
+                    {"line": node.lineno}))
+        return findings
+
+
+# ------------------------------------------------------------- emission tags
+_EMIT_FUNCS = {"write_events", "record_events", "record", "emit", "_write",
+               "counter", "gauge", "histogram"}
+_TAG_RE = re.compile(r"^(serving|router|Train|inference)/[A-Za-z0-9_{}*./]+$")
+
+
+def _literal_tag(node: ast.AST) -> Optional[str]:
+    """Render a Str/JoinedStr AST node to a tag literal (f-string
+    interpolations become ``*``); None when it isn't tag-shaped."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+    elif isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        text = "".join(parts)
+    else:
+        return None
+    return text if _TAG_RE.match(text) else None
+
+
+def iter_emission_tags_from_tree(tree: ast.Module
+                                 ) -> Iterator[Tuple[str, int]]:
+    """Yield ``(tag_literal, lineno)`` for every tag-shaped string constant
+    inside a function that calls one of the emit surfaces (``write_events`` /
+    ``record_events`` / registry ``record`` / ``counter``/``gauge``/
+    ``histogram``). Docstrings are skipped; constants inside an f-string are
+    fragments of the rendered pattern, never tags themselves."""
+
+    def calls_emit(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                fname = None
+                if isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                if fname in _EMIT_FUNCS:
+                    return True
+        return False
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not calls_emit(fn):
+            continue
+        body = fn.body
+        # skip the docstring: prose mentions of tags are not emission sites
+        if (body and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            body = body[1:]
+        for stmt in body:
+            fragment_ids = set()
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.JoinedStr):
+                    for sub in ast.walk(node):
+                        if sub is not node:
+                            fragment_ids.add(id(sub))
+            for node in ast.walk(stmt):
+                if id(node) in fragment_ids:
+                    continue
+                tag = _literal_tag(node)
+                if tag is not None:
+                    yield tag, node.lineno
+
+
+def iter_emission_tags(path: str) -> Iterator[Tuple[str, int]]:
+    """File-path face of :func:`iter_emission_tags_from_tree` (the API
+    ``observability.schema`` re-exports)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    yield from iter_emission_tags_from_tree(tree)
+
+
+class EmissionTagRule(AstRule):
+    """Every emitted metric tag resolves against the declared schema.
+
+    ``resolve`` is injected (``observability.schema.resolve``) so this module
+    stays import-cycle-free; ``modules`` restricts the rule to the declared
+    emitter files (tag-shaped strings elsewhere — docs, tests — are not
+    emission sites)."""
+
+    name = "emission_tags"
+
+    def __init__(self, resolve: Callable[[str], Optional[str]],
+                 modules: Sequence[str]):
+        self.resolve = resolve
+        self.modules = tuple(modules)
+
+    def check(self, tree, source_lines, relpath):
+        if relpath not in self.modules:
+            return []
+        findings = []
+        for tag, lineno in iter_emission_tags_from_tree(tree):
+            if self.resolve(tag) is None:
+                findings.append(Finding(
+                    self.name, SEVERITY_ERROR, f"{relpath}:{lineno}",
+                    f"metric tag {tag!r} is not declared in "
+                    "observability.schema.TAGS — declare it (kind + help) "
+                    "before emitting it", {"tag": tag}))
+        return findings
+
+
+# -------------------------------------------------------------------- runner
+#: paths never linted (generated/vendored would go here)
+DEFAULT_EXCLUDES = ("tests/*", "*/tests/*")
+
+
+def library_files(repo_root: str, package: str = "deepspeed_tpu",
+                  excludes: Sequence[str] = DEFAULT_EXCLUDES) -> List[str]:
+    """Repo-relative paths of every library ``.py`` file under ``package``."""
+    out = []
+    base = os.path.join(repo_root, package)
+    for dirpath, _, names in os.walk(base):
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, name), repo_root)
+            rel = rel.replace(os.sep, "/")
+            if any(fnmatch.fnmatch(rel, pat) for pat in excludes):
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
+def run_ast_rules(repo_root: str, rules: Sequence[AstRule],
+                  paths: Optional[Sequence[str]] = None) -> PassResult:
+    """Parse each file once; feed every rule. ``paths`` (repo-relative)
+    restricts the sweep — the ``--changed-only`` fast mode."""
+    if paths is None:
+        paths = library_files(repo_root)
+    names = "+".join(r.name for r in rules) or "none"
+    result = PassResult("ast_rules", names, checked=0)
+    for rel in paths:
+        full = os.path.join(repo_root, rel)
+        if not os.path.exists(full) or not rel.endswith(".py"):
+            continue
+        with open(full) as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=full)
+        except SyntaxError as e:
+            result.findings.append(Finding(
+                "ast_rules", SEVERITY_ERROR, f"{rel}:{e.lineno or 0}",
+                f"syntax error during lint parse: {e.msg}"))
+            continue
+        result.checked += 1
+        lines = source.splitlines()
+        for rule in rules:
+            result.findings.extend(rule.check(tree, lines, rel))
+    return result

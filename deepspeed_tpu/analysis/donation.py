@@ -1,0 +1,154 @@
+r"""Donation audit: every ``donate_argnums`` buffer is actually donated.
+
+``jax.jit(..., donate_argnums=...)`` is a *request*: if XLA cannot alias a
+donated input to an output (dtype/layout mismatch, output doesn't exist, an
+engine rebinding handed the jit a buffer tree whose structure drifted), it
+silently falls back to a copy — the donated-HBM saving evaporates and, worse,
+callers that rebind "the donated pool" may keep OLD buffers alive (the exact
+bug class the PR 8/9 watchdog/restore seams guard by hand). This pass reads
+the contract off the compiled executable: the ``input_output_alias`` table of
+the optimized HLO must cover every donated (and kept) parameter.
+
+Deliberate non-donation is declared, not silent: pass ``allow=`` patterns
+matched against the flat arg-leaf path (substring by default, e.g.
+``"caches"`` or ``"[2]"`` for the third positional arg; prefix with ``re:``
+for a regex, e.g. ``r"re:^\[2\]"``) and the pass downgrades those leaves to
+``info`` findings that name the allowlist entry — visible in the report,
+not failing it.
+"""
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+
+from .report import (Finding, PassResult, SEVERITY_ERROR, SEVERITY_INFO,
+                     SEVERITY_WARNING)
+
+
+class DonationError(AssertionError):
+    """A donated buffer was not aliased into any output (silent-copy
+    fallback)."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = list(findings)
+        super().__init__("donation contract violated: " +
+                         "; ".join(f.message for f in findings[:6]))
+
+
+def _alias_param_positions(compiled_text: str) -> Optional[set]:
+    """Parameter positions appearing as alias sources in the executable's
+    ``input_output_alias`` table; None when no table exists at all."""
+    m = re.search(r"input_output_alias=\{", compiled_text)
+    if m is None:
+        return None
+    # scan to the matching close brace (entries nest one brace level deep)
+    depth, i = 1, m.end()
+    while i < len(compiled_text) and depth:
+        depth += {"{": 1, "}": -1}.get(compiled_text[i], 0)
+        i += 1
+    body = compiled_text[m.end():i - 1]
+    # entries look like `{0}: (2, {}, may-alias)` — capture the param index
+    return {int(p) for p in re.findall(r":\s*\((\d+)", body)}
+
+
+def _info_aval(info) -> Any:
+    # jax 0.4.x spells it ArgInfo._aval; newer versions may expose .aval
+    return getattr(info, "aval", None) or getattr(info, "_aval", None)
+
+
+def _flat_args_info(lowered) -> List[Tuple[str, Any]]:
+    """``(path, ArgInfo)`` per flattened argument leaf, in parameter order."""
+    is_info = lambda x: hasattr(x, "donated")  # noqa: E731
+    leaves = jax.tree_util.tree_flatten_with_path(
+        lowered.args_info, is_leaf=is_info)[0]
+    return [(jax.tree_util.keystr(path), info) for path, info in leaves]
+
+
+def _allowed(path: str, allow: Sequence[str]) -> Optional[str]:
+    for pat in allow:
+        # plain patterns are SUBSTRINGS (arg paths are full of brackets — a
+        # bracketed substring like "[2]" must never silently become a regex
+        # character class matching the wrong leaves); regex matching is
+        # explicit via an "re:" prefix
+        if pat.startswith("re:"):
+            if re.search(pat[3:], path):
+                return pat
+        elif pat in path:
+            return pat
+    return None
+
+
+def donation_findings(fn, args, kwargs=None, *, donate_argnums=None,
+                      allow: Sequence[str] = (),
+                      target: str = "donation") -> PassResult:
+    """Audit one program's donation contract.
+
+    ``fn`` is either an already-``jax.jit``-ed callable (donation baked in —
+    e.g. an entry of an engine's ``_fns`` cache) or a plain function with
+    ``donate_argnums`` given here. ``args``/``kwargs`` are representative
+    abstract-or-concrete arguments (only shapes/dtypes matter; this lowers,
+    it does not execute).
+    """
+    kwargs = kwargs or {}
+    if donate_argnums is not None:
+        fn = jax.jit(fn, donate_argnums=donate_argnums)
+    if not hasattr(fn, "lower"):
+        raise TypeError("fn must be jax.jit-wrapped (or pass donate_argnums "
+                        "so the pass can wrap it)")
+    lowered = fn.lower(*args, **kwargs)
+    compiled = lowered.compile()
+    flat = _flat_args_info(lowered)
+    donated_idx = [i for i, (_, info) in enumerate(flat) if info.donated]
+    result = PassResult("donation", target, checked=len(donated_idx))
+    if not donated_idx:
+        result.findings.append(Finding(
+            "donation", SEVERITY_WARNING, target,
+            "program donates nothing — donation audit is vacuous here"))
+        return result
+
+    # flat arg index -> executable parameter position (unused args dropped)
+    kept = getattr(getattr(compiled, "_executable", None),
+                   "_kept_var_idx", None)
+    kept = sorted(kept) if kept is not None else list(range(len(flat)))
+    param_pos = {flat_i: pos for pos, flat_i in enumerate(kept)}
+
+    aliased = _alias_param_positions(compiled.as_text())
+    for i in donated_idx:
+        path, info = flat[i]
+        site = f"{target}{path}"
+        if i not in param_pos:
+            result.findings.append(Finding(
+                "donation", SEVERITY_WARNING, site,
+                f"donated argument {path} is unused by the computation "
+                "(dropped from the executable — nothing to alias)",
+                {"aval": str(_info_aval(info))}))
+            continue
+        if aliased is not None and param_pos[i] in aliased:
+            continue
+        pat = _allowed(path, allow)
+        if pat is not None:
+            result.findings.append(Finding(
+                "donation", SEVERITY_INFO, site,
+                f"donated argument {path} not aliased — allowlisted "
+                f"by {pat!r}", {"aval": str(_info_aval(info)), "allow": pat}))
+            continue
+        result.findings.append(Finding(
+            "donation", SEVERITY_ERROR, site,
+            f"donated argument {path} ({_info_aval(info)}) is NOT aliased "
+            "in the compiled executable — silent copy fallback; the caller "
+            "believes this buffer was consumed",
+            {"aval": str(_info_aval(info))}))
+    return result
+
+
+def assert_all_donated(fn, args, kwargs=None, *, donate_argnums=None,
+                       allow: Sequence[str] = (), target: str = "donation"):
+    """Raise :class:`DonationError` unless every donated (kept) buffer is
+    aliased; returns the :class:`~.report.PassResult` when clean."""
+    result = donation_findings(fn, args, kwargs, donate_argnums=donate_argnums,
+                               allow=allow, target=target)
+    errors = [f for f in result.findings if f.severity == SEVERITY_ERROR]
+    if errors:
+        raise DonationError(errors)
+    return result

@@ -1,0 +1,136 @@
+"""Jaxpr structural passes: sub-jaxpr walking and the loop-invariance pin.
+
+:func:`assert_loop_invariant` is the generalized form of the PR 5 dequant-hoist
+check that used to live as a bespoke walk inside ``test_weight_quant.py``: it
+structurally pins values/ops OUT of compiled loop bodies (``while`` — dynamic
+``fori_loop``/``while_loop`` — and ``scan``, which static-bound ``fori_loop``
+lowers to). The jaxpr view is the one that matters: XLA's own LICM may hoist a
+regression in the final HLO on one backend version and not the next, so "the
+optimized HLO happened to be clean" is not a contract — "our trace never put
+it in the body" is.
+
+Predicates:
+
+- ``invar_predicate(aval)`` — flags loop-body *inputs* (while/scan bodies
+  receive loop constants as invars, so "int8 entered the body" means the
+  quantized payload is consumed per-step instead of once per dispatch);
+- ``eqn_predicate(eqn)`` — flags *operations* traced inside a body (e.g.
+  ``lambda e: e.primitive.name == "custom_jvp_call"``).
+"""
+
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+import jax
+
+from .report import Finding, SEVERITY_ERROR
+
+#: primitives whose sub-jaxprs execute once per loop iteration
+LOOP_PRIMITIVES = ("while", "scan")
+
+
+def subjaxprs(eqn) -> Iterator[Any]:
+    """Every inner ``Jaxpr`` reachable from one equation's params (closed
+    jaxprs are unwrapped; lists/tuples of jaxprs — e.g. ``cond`` branches —
+    are walked)."""
+    for param in eqn.params.values():
+        items = param if isinstance(param, (list, tuple)) else [param]
+        for item in items:
+            # ClosedJaxpr first: it forwards .eqns, so the order matters
+            if hasattr(item, "jaxpr"):         # ClosedJaxpr (while/scan/pjit)
+                yield item.jaxpr
+            elif hasattr(item, "eqns"):        # plain Jaxpr (e.g. shard_map)
+                yield item
+
+
+def _as_jaxpr(fn_or_jaxpr, args) -> Any:
+    if hasattr(fn_or_jaxpr, "eqns"):
+        return fn_or_jaxpr
+    if hasattr(fn_or_jaxpr, "jaxpr"):
+        return fn_or_jaxpr.jaxpr
+    return jax.make_jaxpr(fn_or_jaxpr)(*args).jaxpr
+
+
+class LoopInvarianceError(AssertionError):
+    """A value/op the contract pins loop-invariant was traced inside a loop
+    body (e.g. dequant re-derived every decode step)."""
+
+    def __init__(self, what: str, violations: List[str]):
+        self.what = what
+        self.violations = list(violations)
+        detail = "; ".join(violations[:8])
+        if len(violations) > 8:
+            detail += f"; ... ({len(violations) - 8} more)"
+        super().__init__(
+            f"loop-invariance contract {what!r} violated inside compiled "
+            f"loop bodies: {detail}")
+
+
+def loop_body_findings(fn_or_jaxpr, args=(), *,
+                       invar_predicate: Optional[Callable[[Any], bool]] = None,
+                       eqn_predicate: Optional[Callable[[Any], bool]] = None,
+                       what: str = "loop-invariant",
+                       site: str = "jaxpr") -> Tuple[List[Finding], int]:
+    """Walk the program's jaxpr; flag predicate matches inside any loop body.
+
+    Returns ``(findings, n_loop_bodies_inspected)`` — callers can assert the
+    walk actually saw a loop (a refactor that removes the loop entirely would
+    otherwise pass vacuously).
+    """
+    if invar_predicate is None and eqn_predicate is None:
+        raise ValueError("need invar_predicate and/or eqn_predicate")
+    jaxpr = _as_jaxpr(fn_or_jaxpr, args)
+    findings: List[Finding] = []
+    seen_bodies = [0]
+
+    def walk(jx, inside: bool, path: str):
+        if inside:
+            if invar_predicate is not None:
+                for v in jx.invars:
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and invar_predicate(aval):
+                        findings.append(Finding(
+                            "loop_invariance", SEVERITY_ERROR, site,
+                            f"{what}: loop-body input {aval} at {path}",
+                            {"aval": str(aval), "loop_path": path}))
+            if eqn_predicate is not None:
+                for eqn in jx.eqns:
+                    if eqn_predicate(eqn):
+                        findings.append(Finding(
+                            "loop_invariance", SEVERITY_ERROR, site,
+                            f"{what}: op {eqn.primitive.name} traced inside "
+                            f"loop body at {path}",
+                            {"primitive": eqn.primitive.name,
+                             "loop_path": path}))
+        for eqn in jx.eqns:
+            is_loop = eqn.primitive.name in LOOP_PRIMITIVES
+            if is_loop and not inside:
+                seen_bodies[0] += 1
+            sub_path = (f"{path}/{eqn.primitive.name}"
+                        if is_loop else path)
+            for sub in subjaxprs(eqn):
+                walk(sub, inside or is_loop, sub_path)
+
+    walk(jaxpr, False, site)
+    return findings, seen_bodies[0]
+
+
+def assert_loop_invariant(fn_or_jaxpr, args=(), *,
+                          invar_predicate=None, eqn_predicate=None,
+                          what: str = "loop-invariant",
+                          require_loop: bool = True) -> int:
+    """Raise :class:`LoopInvarianceError` if the predicate matches inside any
+    compiled loop body; returns the number of loop bodies inspected.
+
+    ``require_loop=True`` (default) also raises if the program contains NO
+    loop at all — the pin must fail loudly when the loop it guards is
+    refactored away, not silently pass on an empty walk.
+    """
+    findings, n_loops = loop_body_findings(
+        fn_or_jaxpr, args, invar_predicate=invar_predicate,
+        eqn_predicate=eqn_predicate, what=what)
+    if require_loop and n_loops == 0:
+        raise LoopInvarianceError(what, ["program contains no while/scan "
+                                         "loop — pin target vanished"])
+    if findings:
+        raise LoopInvarianceError(what, [f.message for f in findings])
+    return n_loops

@@ -1,0 +1,121 @@
+"""Findings, per-pass results, and the JSON report ``bin/ds-tpu-lint`` emits.
+
+One :class:`Finding` is one contract violation (or advisory note) anchored to
+a site — a ``path:line`` for AST rules, a ``program/site`` name for traced
+passes. A :class:`PassResult` groups one pass's findings over one target with
+a count of units it inspected (so "0 findings" is distinguishable from "never
+looked"). :class:`Report` aggregates pass results and serializes to the JSON
+schema the lint smoke test pins:
+
+.. code-block:: json
+
+    {"version": 1, "ok": false, "n_errors": 1, "n_warnings": 0,
+     "passes": [{"name": "donation", "target": "serve_chunk", "checked": 12,
+                 "findings": [{"pass": "donation", "severity": "error",
+                               "site": "...", "message": "...",
+                               "details": {}}]}]}
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import json
+
+SEVERITY_ERROR = "error"      # contract violated: lint exits nonzero
+SEVERITY_WARNING = "warning"  # suspicious but allowlisted/ambiguous
+SEVERITY_INFO = "info"        # advisory context (never fails the sweep)
+
+_SEVERITIES = (SEVERITY_ERROR, SEVERITY_WARNING, SEVERITY_INFO)
+
+
+@dataclass
+class Finding:
+    """One contract violation, anchored to a site."""
+    pass_name: str
+    severity: str
+    site: str
+    message: str
+    details: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.severity not in _SEVERITIES:
+            raise ValueError(f"severity must be one of {_SEVERITIES}, "
+                             f"got {self.severity!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"pass": self.pass_name, "severity": self.severity,
+                "site": self.site, "message": self.message,
+                "details": dict(self.details)}
+
+    def __str__(self):
+        return f"[{self.pass_name}] {self.severity}: {self.site}: {self.message}"
+
+
+@dataclass
+class PassResult:
+    """One pass's findings over one target."""
+    name: str
+    target: str
+    findings: List[Finding] = field(default_factory=list)
+    #: units inspected (donated leaves, cached fns, AST files, collective
+    #: eqns ...) — lets a report distinguish "clean" from "vacuous"
+    checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.severity == SEVERITY_ERROR for f in self.findings)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "target": self.target,
+                "checked": int(self.checked),
+                "findings": [f.to_dict() for f in self.findings]}
+
+
+class Report:
+    """Aggregate of pass results; the sweep's exit status and JSON artifact."""
+
+    VERSION = 1
+
+    def __init__(self):
+        self.results: List[PassResult] = []
+
+    def add(self, result: PassResult) -> PassResult:
+        self.results.append(result)
+        return result
+
+    def findings(self, severity: str = None) -> List[Finding]:
+        out = [f for r in self.results for f in r.findings]
+        if severity is not None:
+            out = [f for f in out if f.severity == severity]
+        return out
+
+    @property
+    def n_errors(self) -> int:
+        return len(self.findings(SEVERITY_ERROR))
+
+    @property
+    def n_warnings(self) -> int:
+        return len(self.findings(SEVERITY_WARNING))
+
+    @property
+    def ok(self) -> bool:
+        return self.n_errors == 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"version": self.VERSION, "ok": self.ok,
+                "n_errors": self.n_errors, "n_warnings": self.n_warnings,
+                "passes": [r.to_dict() for r in self.results]}
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def summary(self) -> str:
+        lines = [f"ds-tpu-lint: {len(self.results)} pass runs, "
+                 f"{self.n_errors} errors, {self.n_warnings} warnings"]
+        for r in self.results:
+            status = "ok" if r.ok else "FAIL"
+            lines.append(f"  {status:4s} {r.name:<18s} {r.target} "
+                         f"(checked {r.checked})")
+            for f in r.findings:
+                lines.append(f"       - {f}")
+        return "\n".join(lines)

@@ -1,0 +1,125 @@
+"""Retrace / compile-cache lint: one compile per cache key, checked.
+
+The serving/runtime engines carry an explicit compile cache (``engine._fns``):
+the documented property is ONE compile per ``(slots, cap, chunk, sampling)``
+(executor chunk), per ``(prompt-bucket, cap, sampling)`` (prefill), per
+suffix bucket, per train-step build. A retrace inside one cached entry —
+weak-type promotion (a python int where an ``np.int32`` belonged), dtype or
+shape drift, a non-hashable static argument forcing cache misses — silently
+doubles compile time and HBM, and on the serving hot path reads as a wedged
+replica (the PR 8 watchdog false-kill class). jax exposes the per-function
+compile count as ``jitted._cache_size()``; this lint walks a cache dict,
+snapshots counts, and fails when any entry exceeds its budget or grows
+between snapshots.
+"""
+
+from typing import Any, Dict, Iterator, List, Tuple
+
+from .report import Finding, PassResult, SEVERITY_ERROR, SEVERITY_WARNING
+
+
+class RetraceError(AssertionError):
+    """A cached compiled fn retraced (weak-type/shape drift)."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = list(findings)
+        super().__init__("retrace contract violated: " +
+                         "; ".join(f.message for f in findings[:6]))
+
+
+def _iter_jitted(value, prefix: str) -> Iterator[Tuple[str, Any]]:
+    """Yield ``(label, jitted_fn)`` for every compiled fn inside a cache
+    value (entries may be a jitted fn, a tuple of them — e.g. the generate
+    path's ``(prefill, decode_loop)`` — or a nested dict)."""
+    if hasattr(value, "_cache_size"):
+        yield prefix, value
+    elif isinstance(value, (tuple, list)):
+        for i, item in enumerate(value):
+            yield from _iter_jitted(item, f"{prefix}[{i}]")
+    elif isinstance(value, dict):
+        for k, item in value.items():
+            yield from _iter_jitted(item, f"{prefix}[{k!r}]")
+
+
+def cache_compile_counts(fns: Dict[Any, Any]) -> Dict[str, int]:
+    """``{cache-key label: compile count}`` for a ``_fns``-style dict."""
+    out = {}
+    for key, value in fns.items():
+        for label, fn in _iter_jitted(value, str(key)):
+            out[label] = int(fn._cache_size())
+    return out
+
+
+class CompileCacheLint:
+    """Wraps an engine/executor compile cache and asserts the one-compile-
+    per-key property across a workload.
+
+    Usage::
+
+        lint = CompileCacheLint(engine._fns, target="serve-engine")
+        ...run warmup workload (every key compiles once)...
+        lint.snapshot()
+        ...repeat the same workload shapes...
+        result = lint.findings()     # any growth/extra compile = error
+
+    ``findings(max_per_key=1)`` alone (no snapshot) checks the absolute
+    budget: no cached entry may ever have compiled more than once.
+    """
+
+    def __init__(self, fns: Dict[Any, Any], target: str = "compile-cache"):
+        self._fns = fns
+        self.target = target
+        self._snap: Dict[str, int] = {}
+        self._snapped = False
+
+    def snapshot(self) -> Dict[str, int]:
+        self._snap = cache_compile_counts(self._fns)
+        self._snapped = True
+        return dict(self._snap)
+
+    def findings(self, max_per_key: int = 1) -> PassResult:
+        counts = cache_compile_counts(self._fns)
+        result = PassResult("retrace", self.target, checked=len(counts))
+        if not counts:
+            result.findings.append(Finding(
+                "retrace", SEVERITY_WARNING, self.target,
+                "compile cache is empty — retrace lint is vacuous here"))
+            return result
+        for label, count in counts.items():
+            if count > max_per_key:
+                result.findings.append(Finding(
+                    "retrace", SEVERITY_ERROR, f"{self.target}/{label}",
+                    f"cache key compiled {count}x (budget {max_per_key}) — "
+                    "unexpected retrace (weak-type promotion, shape drift, "
+                    "or non-hashable static arg)",
+                    {"count": count, "budget": max_per_key}))
+            baseline = self._snap.get(label)
+            if baseline is None:
+                if self._snapped and count > 0:
+                    # drift usually mints a NEW cache key rather than
+                    # retracing an old one (a drifted shape hashes to a
+                    # different (slots, cap, chunk, ...) tuple) — a key born
+                    # after the warmup snapshot is the same contract breach
+                    result.findings.append(Finding(
+                        "retrace", SEVERITY_ERROR, f"{self.target}/{label}",
+                        f"NEW cache key compiled {count}x after the warmup "
+                        "snapshot — the repeated workload was supposed to "
+                        "hit existing keys (shape/key drift)",
+                        {"count": count}))
+            elif count > baseline and count <= max_per_key:
+                # growth within the absolute budget (e.g. a warm key
+                # recompiling under a budget of 2) — still a retrace
+                result.findings.append(Finding(
+                    "retrace", SEVERITY_ERROR, f"{self.target}/{label}",
+                    f"cache key retraced after warmup ({baseline} -> {count} "
+                    "compiles for repeated identical workload shapes)",
+                    {"baseline": baseline, "count": count}))
+        return result
+
+    def assert_clean(self, max_per_key: int = 1) -> PassResult:
+        result = self.findings(max_per_key=max_per_key)
+        errors = [f for f in result.findings
+                  if f.severity == SEVERITY_ERROR]
+        if errors:
+            raise RetraceError(errors)
+        return result

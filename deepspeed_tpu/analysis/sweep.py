@@ -1,0 +1,370 @@
+"""The ``bin/ds-tpu-lint`` whole-repo sweep: canonical traces + AST rules.
+
+Runs every contract pass against the repo's *real* programs — not toys:
+
+- **serving lane** — a tiny ``InferenceEngine`` (fp32 + int8-quantized) under
+  a real :class:`ChunkedDecodeExecutor`: donation audit on the chunk /
+  suffix-prefill / KV-pool movers, retrace lint across a repeated workload
+  (the documented one-compile-per-key property), the dequant-hoist
+  loop-invariance pin on BOTH decode bodies (while-loop generate and
+  scan-lowered chunk), and the trace-time host-sync guard;
+- **train lane** — a quantized-DP ``DeepSpeedEngine`` on the virtual CPU
+  mesh: donation audit on the real ``train_step`` (state + EF residual),
+  retrace lint across repeated steps;
+- **overlap lane** — the ppermute-ring and monolithic collective matmuls:
+  jaxpr-accounted bytes-on-wire cross-checked against ``CollectiveSpans``
+  (including a deliberately twice-calling trace that pins per-site
+  accumulation — the PR 3 overwrite class);
+- **AST lane** — bare-assert ban, emission-tag schema, hot-path host-sync
+  rule over every library file (or only changed files in ``--changed-only``
+  mode).
+
+Everything runs offline on CPU (``JAX_PLATFORMS=cpu``, virtual 8-device
+mesh); the report serializes to the JSON schema in :mod:`.report`.
+"""
+
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+from .report import Finding, PassResult, Report, SEVERITY_ERROR
+
+_TINY = dict(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=2, n_head=4)
+_CAP = 32
+
+
+def _infra_result(name: str, target: str, exc: Exception) -> PassResult:
+    r = PassResult(name, target, checked=0)
+    r.findings.append(Finding(
+        name, SEVERITY_ERROR, target,
+        f"sweep lane crashed: {type(exc).__name__}: {exc}",
+        {"exception": type(exc).__name__}))
+    return r
+
+
+# ------------------------------------------------------------- serving lane
+def serving_lane(report: Report) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..inference.config import DeepSpeedInferenceConfig
+    from ..inference.decode_fns import (build_decode_chunk, build_decode_loop,
+                                        make_select_fn, make_slot_select_fn)
+    from ..inference.engine import InferenceEngine
+    from ..inference.serving.executor import ChunkedDecodeExecutor
+    from ..models.causal_lm import gpt2_cfg, init_cache
+    from ..parallel.mesh import set_global_mesh
+    from .donation import donation_findings
+    from .host_sync import trace_sync_findings
+    from .jaxpr_passes import loop_body_findings
+    from .retrace import CompileCacheLint
+
+    cfg = gpt2_cfg(**_TINY, dtype=jnp.float32)
+    engine = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=_CAP))
+    raw = jax.tree_util.tree_map(np.asarray, engine.params)
+    engine_q = InferenceEngine((cfg, raw), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=_CAP,
+        weight_quant={"enabled": True, "bits": 8}))
+
+    ex = ChunkedDecodeExecutor(engine, slots=2, cap=_CAP, chunk_size=3)
+    lint = CompileCacheLint(engine._fns, target="serving-engine")
+    rng = np.random.default_rng(0)
+
+    def workload():
+        prompt = rng.integers(0, _TINY["vocab_size"], size=8).astype(np.int32)
+        slot = ex.pool.acquire()
+        tok0, _ = ex.prefill_into_slot(slot, prompt, seed=0)
+        S = ex.slots
+        state = dict(
+            toks=np.full((S,), tok0, np.int32),
+            lens=np.full((S,), 8, np.int32),
+            active=np.array([True, False]),
+            remaining=np.full((S,), 5, np.int32),
+            eos=np.full((S,), -1, np.int32),
+            seeds=np.zeros((S,), np.int32), steps=np.zeros((S,), np.int32))
+        r = ex.run_chunk(state["toks"], state["lens"], state["active"],
+                         state["remaining"], state["eos"], state["seeds"],
+                         state["steps"])
+        ex.run_chunk(r.toks[:, 0], r.lens, r.active, r.remaining,
+                     state["eos"], state["seeds"], r.steps)
+        ex.pool.release(slot)
+
+    workload()                   # warmup: every key compiles exactly once
+    lint.snapshot()
+    workload()                   # identical shapes: zero new compiles allowed
+    report.add(lint.findings())
+
+    # donation: the real chunk fn + the pool's donated movers
+    chunk_key = next(k for k in engine._fns if k[0] == "serve_chunk")
+    S = ex.slots
+    chunk_args = (engine.params, jnp.zeros((S, 1), jnp.int32), ex.pool.caches,
+                  jnp.zeros((S,), jnp.int32), jnp.zeros((S,), bool),
+                  jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+                  jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+                  ex._base_key)
+    report.add(donation_findings(engine._fns[chunk_key], chunk_args,
+                                 target="serve_chunk"))
+    one = init_cache(cfg, 1, _CAP, dtype=engine.dtype)
+    report.add(donation_findings(ex.pool._scatter_fn,
+                                 (ex.pool.caches, one, 0),
+                                 target="kv_pool.scatter"))
+    report.add(donation_findings(ex.pool._zero_fn, (ex.pool.caches, 0),
+                                 target="kv_pool.zero_fill"))
+    # suffix prefill (prefix-cache hit path): donates the POOL through the jit
+    sfn = ex._suffix_prefill_fn(8)
+    sargs = (engine.params, ex.pool.caches, np.int32(0),
+             jnp.zeros((1, 8), jnp.int32), jnp.asarray([4], jnp.int32),
+             jnp.asarray([4], jnp.int32), jnp.asarray([0], jnp.int32),
+             ex._base_key)
+    report.add(donation_findings(sfn, sargs, target="serve_suffix_prefill"))
+
+    # loop-invariance: dequant hoisted out of BOTH decode bodies (int8 engine)
+    int8_invar = lambda a: getattr(a, "dtype", None) == jnp.int8  # noqa: E731
+
+    def loop_pin(fn, args, site):
+        findings, n_loops = loop_body_findings(
+            fn, args, invar_predicate=int8_invar, what="dequant-hoist",
+            site=site)
+        res = PassResult("loop_invariance", site, findings, n_loops)
+        if n_loops == 0:
+            res.findings.append(Finding(
+                "loop_invariance", SEVERITY_ERROR, site,
+                "no loop found — the dequant-hoist pin target vanished"))
+        report.add(res)
+
+    select = make_select_fn(False, 1.0, 0, 1.0)
+    caches = init_cache(cfg, 2, _CAP, dtype=engine_q.dtype)
+    loop = build_decode_loop(engine_q.module, engine_q._dequant, select, _CAP,
+                             overlap=engine_q.comm_overlap)
+    largs = (engine_q.params, jnp.zeros((2, 1), jnp.int32), caches,
+             jnp.full((2,), 8, jnp.int32), np.int32(8), np.int32(-1),
+             jax.random.PRNGKey(0))
+    loop_pin(loop, largs, "decode_loop")
+
+    slot_select = make_slot_select_fn(False, 1.0, 0, 1.0)
+    chunk = build_decode_chunk(engine_q.module, engine_q._dequant,
+                               slot_select, 3,
+                               overlap=engine_q.comm_overlap)
+    qcaches = init_cache(cfg, 2, _CAP, dtype=engine_q.dtype)
+    cargs = (engine_q.params, jnp.zeros((2, 1), jnp.int32), qcaches,
+             jnp.full((2,), 8, jnp.int32), jnp.ones((2,), bool),
+             jnp.full((2,), 5, jnp.int32), jnp.full((2,), -1, jnp.int32),
+             jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32),
+             jax.random.PRNGKey(0))
+    loop_pin(chunk, cargs, "decode_chunk")
+
+    # host-sync runtime guard: the traced chunk body performs zero transfers
+    report.add(trace_sync_findings(chunk, cargs, target="decode_chunk"))
+    set_global_mesh(None)
+
+
+# --------------------------------------------------------------- train lane
+def train_lane(report: Report) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models import GPT2Config, gpt2_model
+    from ..parallel.mesh import MeshSpec, set_global_mesh
+    from ..runtime.engine import DeepSpeedEngine
+    from .donation import donation_findings
+    from .retrace import CompileCacheLint
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        r = PassResult("retrace", "train-engine", checked=0)
+        r.findings.append(Finding(
+            "retrace", SEVERITY_ERROR, "train-engine",
+            f"virtual mesh needs 8 devices, found {len(devices)} — run via "
+            "bin/ds-tpu-lint (it sets xla_force_host_platform_device_count)"))
+        report.add(r)
+        return
+    set_global_mesh(None)
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                     n_head=4, dropout=0.0, dtype=jnp.float32,
+                     scan_layers=True)
+    engine = DeepSpeedEngine(
+        model=gpt2_model(cfg, sample_seq_len=32),
+        config={"train_batch_size": 16, "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 0},
+                "comm_overlap": {"enabled": True,
+                                 "quantized_allreduce": True},
+                "steps_per_print": 10**9},
+        mesh_spec=MeshSpec({"data": 8}, devices))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(16, 32),
+                                       dtype=np.int32)}
+    lint = CompileCacheLint(engine._fns, target="train-engine")
+    engine.train_batch(batch)
+    lint.snapshot()
+    engine.train_batch(batch)
+    report.add(lint.findings())
+
+    gbatch = engine._globalize(engine._reshape_for_gas(batch),
+                               leading_gas=True)
+    args = (engine.state, gbatch, np.float32(1e-2), np.float32(1.0),
+            engine._qar_residual)
+    report.add(donation_findings(engine._fns["train_step"], args,
+                                 target="train_step_quantized"))
+    set_global_mesh(None)
+
+
+# ------------------------------------------------------------- overlap lane
+def overlap_lane(report: Report) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..parallel import overlap as ov
+    from ..parallel.mesh import AXIS_TENSOR, MeshSpec
+    from ..utils.jax_compat import shard_map
+    from .collectives import crosscheck_findings
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        r = PassResult("collective_schema", "overlap-ring", checked=0)
+        r.findings.append(Finding(
+            "collective_schema", SEVERITY_ERROR, "overlap-ring",
+            f"need 4 devices for the ring lane, found {len(devices)}"))
+        report.add(r)
+        return
+    mesh = MeshSpec({"tensor": 4}, devices[:4])
+    ag_specs = dict(mesh=mesh.mesh, axis_names={AXIS_TENSOR},
+                    in_specs=(P(AXIS_TENSOR, None), P(None, None)),
+                    out_specs=P(None, None), check_vma=False)
+    rs_specs = dict(mesh=mesh.mesh, axis_names={AXIS_TENSOR},
+                    in_specs=(P(None, AXIS_TENSOR), P(AXIS_TENSOR, None)),
+                    out_specs=P(AXIS_TENSOR, None), check_vma=False)
+    x = jnp.ones((8, 16), jnp.float32)
+    w = jnp.ones((16, 6), jnp.float32)
+
+    lanes = [
+        ("ring_allgather_matmul", ag_specs, (x, w),
+         lambda a, b: ov.chunked_allgather_matmul(
+             a, b, AXIS_TENSOR, site="lint.ring_ag")),
+        ("ring_matmul_reduce_scatter", rs_specs, (x, w),
+         lambda a, b: ov.chunked_matmul_reduce_scatter(
+             a, b, AXIS_TENSOR, site="lint.ring_rs")),
+        ("monolithic_allgather_matmul", ag_specs, (x, w),
+         lambda a, b: ov.allgather_matmul_monolithic(
+             a, b, AXIS_TENSOR, site="lint.mono_ag")),
+        ("monolithic_matmul_reduce_scatter", rs_specs, (x, w),
+         lambda a, b: ov.matmul_reduce_scatter_monolithic(
+             a, b, AXIS_TENSOR, site="lint.mono_rs")),
+        # one site traced twice in a single program: pins ACCUMULATION of
+        # bytes_total across traces (the PR 3 last-call-overwrite class)
+        ("ring_site_accumulation", ag_specs, (x, w),
+         lambda a, b: ov.chunked_allgather_matmul(
+             a, b, AXIS_TENSOR, site="lint.ring_twice")
+         + ov.chunked_allgather_matmul(
+             a, b, AXIS_TENSOR, site="lint.ring_twice")),
+    ]
+    for name, specs, args, body in lanes:
+        fn = shard_map(body, **specs)
+        report.add(crosscheck_findings(fn, args, site_prefixes=("lint.",),
+                                       target=name))
+
+
+# ------------------------------------------------------------------ AST lane
+def ast_lane(report: Report, repo_root: str,
+             paths: Optional[Sequence[str]] = None) -> None:
+    from ..observability.schema import emission_tag_rule
+    from .ast_rules import BareAssertRule, run_ast_rules
+    from .host_sync import HOT_PATH_SPECS, hot_path_sync_findings
+    report.add(run_ast_rules(repo_root,
+                             [BareAssertRule(), emission_tag_rule()],
+                             paths=paths))
+    if paths is None:
+        report.add(hot_path_sync_findings(repo_root))
+    else:
+        specs = [s for s in HOT_PATH_SPECS if s.path in set(paths)]
+        if specs:
+            report.add(hot_path_sync_findings(repo_root, specs))
+
+
+# -------------------------------------------------------------------- driver
+def changed_files(repo_root: str, base: str = "HEAD") -> List[str]:
+    """Repo-relative changed ``deepspeed_tpu/*.py`` paths vs ``base`` —
+    including UNTRACKED files (a brand-new module is exactly what a
+    pre-commit lint run must check); empty when git is unavailable.
+    NUL-separated so paths with whitespace survive."""
+    cmds = (
+        ["git", "diff", "--name-only", "-z", base, "--", "deepspeed_tpu"],
+        ["git", "ls-files", "--others", "--exclude-standard", "-z", "--",
+         "deepspeed_tpu"],
+    )
+    paths: List[str] = []
+    for cmd in cmds:
+        try:
+            out = subprocess.run(cmd, cwd=repo_root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return []
+        if out.returncode != 0:
+            continue
+        paths.extend(p for p in out.stdout.split("\0")
+                     if p.endswith(".py") and p not in paths)
+    return paths
+
+
+def run_sweep(repo_root: str, *, ast_only: bool = False,
+              paths: Optional[Sequence[str]] = None) -> Report:
+    report = Report()
+    ast_lane(report, repo_root, paths=paths)
+    if not ast_only:
+        for lane in (serving_lane, train_lane, overlap_lane):
+            try:
+                lane(report)
+            except Exception as e:  # a crashed lane is a failed sweep
+                report.add(_infra_result(lane.__name__, "sweep", e))
+    return report
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI body for ``bin/ds-tpu-lint`` (env already prepared there)."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="ds-tpu-lint",
+        description="Program-contract analyzer: donation / retrace / "
+                    "host-sync / loop-invariance / collective-schema passes "
+                    "over the repo's canonical traces, plus AST rules.")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the JSON report to PATH ('-' = stdout)")
+    parser.add_argument("--ast-only", action="store_true",
+                        help="skip the traced lanes (fast source-only mode)")
+    parser.add_argument("--changed-only", nargs="?", const="HEAD",
+                        metavar="BASE",
+                        help="AST rules on files changed vs BASE "
+                             "(default HEAD); implies --ast-only")
+    parser.add_argument("--repo-root", default=None)
+    args = parser.parse_args(argv)
+
+    repo_root = args.repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    paths = None
+    ast_only = args.ast_only
+    if args.changed_only is not None:
+        paths = changed_files(repo_root, args.changed_only)
+        ast_only = True
+        if not paths:
+            print("ds-tpu-lint: no changed deepspeed_tpu/*.py files vs "
+                  f"{args.changed_only}")
+    import sys
+    if args.json == "-":
+        # stdout must carry ONLY the report so `--json -` pipes cleanly:
+        # the traced lanes' engine logs default to stdout — move them
+        from ..utils.logging import logger as ds_logger
+        for handler in ds_logger.handlers:
+            if getattr(handler, "stream", None) is sys.stdout:
+                handler.stream = sys.stderr
+    report = run_sweep(repo_root, ast_only=ast_only, paths=paths)
+    if args.json == "-":
+        print(report.to_json())
+        print(report.summary(), file=sys.stderr)
+    else:
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(report.to_json())
+            print(f"ds-tpu-lint: report written to {args.json}")
+        print(report.summary())
+    return 0 if report.ok else 1

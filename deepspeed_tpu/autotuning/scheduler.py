@@ -40,8 +40,8 @@ class ExperimentScheduler:
         self.timeout_s = float(timeout_s)
         self.max_parallel = max(1, int(max_parallel))
         self.slot_envs = slot_envs or [{}] * self.max_parallel
-        assert len(self.slot_envs) >= self.max_parallel, \
-            "need one env overlay per parallel slot"
+        if not (len(self.slot_envs) >= self.max_parallel):
+            raise AssertionError("need one env overlay per parallel slot")
         self.python = python or sys.executable
 
     def _launch(self, exp_id: int, overrides: Dict, workdir: str, slot: int):

@@ -79,7 +79,8 @@ class DeepSpeedCheckpoint:
 
     def __init__(self, dir: str, tp_degree: Optional[int] = None,
                  pp_degree: Optional[int] = None, dp_degree: Optional[int] = None):
-        assert os.path.isdir(dir), f"{dir} is not a checkpoint folder"
+        if not (os.path.isdir(dir)):
+            raise AssertionError(f"{dir} is not a checkpoint folder")
         self.dir = dir
         self.file_list = _files(dir)
         self.zero_files = get_zero_files(dir)
@@ -119,7 +120,8 @@ class DeepSpeedCheckpoint:
                            dp_index: int = 0) -> List[str]:
         """ZeRO optim files the given NEW-topology rank must merge (reference
         ``ZeROCheckpoint.get_files_for_rank``)."""
-        assert self._file_map is not None, "no pipeline layout in this checkpoint"
+        if not (self._file_map is not None):
+            raise AssertionError("no pipeline layout in this checkpoint")
         idxs = self._file_map[dp_index][(pp_index, tp_index)]
         return [self.zero_files[i] for i in idxs]
 
@@ -143,7 +145,8 @@ class DeepSpeedCheckpoint:
     def merged_layer_state(self, layer_key: str) -> Dict[str, np.ndarray]:
         """One sequential layer's full tensors: load its tp shard files, merge."""
         shards = [_torch_load(f) for f in self.layer_shards(layer_key)]
-        assert shards, f"no files for layer {layer_key!r}"
+        if not (shards):
+            raise AssertionError(f"no files for layer {layer_key!r}")
         out = {}
         for name in shards[0]:
             vals = [_np(s[name]) for s in shards]
@@ -174,8 +177,10 @@ class DeepSpeedCheckpoint:
         (reference ``utils/zero_to_fp32.py`` for stage 1/2 files): concatenate each
         param group's per-dp flat partitions, trim padding, split per the
         ``param_shapes`` recorded in the matching ``mp_rank_*`` model file."""
-        assert self.zero_files, "no zero_pp_rank_* files in this checkpoint"
-        assert self.mp_rank_files, "need mp_rank_* model files for param_shapes"
+        if not (self.zero_files):
+            raise AssertionError("no zero_pp_rank_* files in this checkpoint")
+        if not (self.mp_rank_files):
+            raise AssertionError("need mp_rank_* model files for param_shapes")
         model_sd = _torch_load(self.mp_rank_files[0])
         param_shapes = model_sd[PARAM_SHAPES]
         if isinstance(param_shapes, dict):
@@ -198,8 +203,8 @@ class DeepSpeedCheckpoint:
             offset = 0
             for name, shape in group_shapes.items():
                 n = int(np.prod(shape))
-                assert offset + n <= flat.size, \
-                    f"group {gi} underflow at {name} (stage {stage})"
+                if not (offset + n <= flat.size):
+                    raise AssertionError(f"group {gi} underflow at {name} (stage {stage})")
                 out[name] = flat[offset:offset + n].reshape(tuple(shape))
                 offset += n
             if offset != flat.size:
@@ -229,7 +234,8 @@ def split_megatron_qkv(qkv: np.ndarray, n_head: int):
     (reference ``megatron/model/transformer.py`` fused QKV; the containers undo this in
     ``module_inject/containers/megatron_gpt.py``)."""
     three_h = qkv.shape[0]
-    assert three_h % (3 * n_head) == 0, (qkv.shape, n_head)
+    if not (three_h % (3 * n_head) == 0):
+        raise AssertionError((qkv.shape, n_head))
     hn = three_h // (3 * n_head)
     parts = qkv.reshape(n_head, 3, hn, *qkv.shape[1:])
     q, k, v = (parts[:, i].reshape(n_head * hn, *qkv.shape[1:]) for i in range(3))
@@ -297,6 +303,6 @@ def to_causal_lm_params(ckpt: "DeepSpeedCheckpoint", n_head: int,
         if "weight" in names and names["weight"].ndim == 1:   # final layernorm
             tree["ln_f"] = {"scale": names["weight"], "bias": names["bias"]}
     if n_layer is not None:
-        assert transformer_idx == n_layer, \
-            f"checkpoint has {transformer_idx} transformer layers, expected {n_layer}"
+        if not (transformer_idx == n_layer):
+            raise AssertionError(f"checkpoint has {transformer_idx} transformer layers, expected {n_layer}")
     return tree

@@ -81,7 +81,8 @@ def _gather_engine_state(engine) -> Tuple[Dict[str, np.ndarray],
         m_named = v_named = None
         if ms is not None:
             names = list(params.keys())
-            assert len(names) == len(ms)
+            if not (len(names) == len(ms)):
+                raise AssertionError('len(names) == len(ms)')
             m_named = {n: np.asarray(m, np.float32).reshape(params[n].shape)
                        for n, m in zip(names, ms)}
             v_named = {n: np.asarray(v, np.float32).reshape(params[n].shape)
@@ -120,7 +121,8 @@ def _gather_engine_state(engine) -> Tuple[Dict[str, np.ndarray],
                 "exp_avg/exp_avg_sq — the checkpoint carries weights only and a "
                 "torch-side resume restarts optimizer state from zero")
         if ms is not None:
-            assert len(names) == len(ms)
+            if not (len(names) == len(ms)):
+                raise AssertionError('len(names) == len(ms)')
             m_named = {n: np.asarray(m, np.float32).reshape(params[n].shape)
                        for n, m in zip(names, ms)}
             v_named = {n: np.asarray(v, np.float32).reshape(params[n].shape)

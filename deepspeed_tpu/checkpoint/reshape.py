@@ -22,7 +22,8 @@ from .constants import (BF16_ZERO_FILE_PREFIX, FP16_ZERO_FILE_PREFIX,
 
 
 def _partition(lst: List, n: int) -> List[List]:
-    assert len(lst) % n == 0, f"cannot partition {len(lst)} items into {n}"
+    if not (len(lst) % n == 0):
+        raise AssertionError(f"cannot partition {len(lst)} items into {n}")
     sz = len(lst) // n
     return [lst[i * sz:(i + 1) * sz] for i in range(n)]
 
@@ -54,8 +55,8 @@ def reshape_meg_2d_parallel(old_pp: int, old_tp: int, new_pp: int, new_tp: int
     contracting tp by r merges r consecutive tp ranks, contracting pp by r merges r
     consecutive pp rows — the same grouping ``reshape_meg_2d.py`` produces.
     """
-    assert old_pp % new_pp == 0 and old_tp % new_tp == 0, \
-        f"degrees must contract evenly: pp {old_pp}->{new_pp}, tp {old_tp}->{new_tp}"
+    if not (old_pp % new_pp == 0 and old_tp % new_tp == 0):
+        raise AssertionError(f"degrees must contract evenly: pp {old_pp}->{new_pp}, tp {old_tp}->{new_tp}")
     # start from the identity map, contract tp, then pp
     cells = {(p, t): [p * old_tp + t] for p in range(old_pp) for t in range(old_tp)}
     if new_tp != old_tp:
@@ -76,7 +77,8 @@ def reshape_3d(src: Model3DDescriptor, dst: Model3DDescriptor
     Old global rank = dp_index * (pp*tp) + 2d_index (dp outermost, matching the
     reference's ``flatten_dp_dimension``)."""
     ok, errs = src.can_reshape(dst)
-    assert ok, ",".join(errs)
+    if not (ok):
+        raise AssertionError(",".join(errs))
     base = reshape_meg_2d_parallel(src.pp_degree, src.tp_degree,
                                    dst.pp_degree, dst.tp_degree)
     plane = src.pp_degree * src.tp_degree

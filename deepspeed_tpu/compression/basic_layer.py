@@ -62,7 +62,8 @@ def quantize_dequantize(x, bits, quantization_type: str = "symmetric",
         scale = jnp.where(amax > 0, amax / qmax, 1.0)
         q = xg / scale
         if stochastic:
-            assert rng is not None, "stochastic rounding needs an rng"
+            if not (rng is not None):
+                raise AssertionError("stochastic rounding needs an rng")
             q = jnp.floor(q + jax.random.uniform(rng, q.shape))
         else:
             q = jnp.round(q)
@@ -74,7 +75,8 @@ def quantize_dequantize(x, bits, quantization_type: str = "symmetric",
         scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
         q = (xg - lo) / scale
         if stochastic:
-            assert rng is not None, "stochastic rounding needs an rng"
+            if not (rng is not None):
+                raise AssertionError("stochastic rounding needs an rng")
             q = jnp.floor(q + jax.random.uniform(rng, q.shape))
         else:
             q = jnp.round(q)
@@ -120,7 +122,8 @@ def head_mask(w, dense_ratio: float, num_heads: int, method: str = "l1"):
     projection (in_dim split into heads along dim 0 — reference
     ``enable_head_pruning`` on attn_ow)."""
     in_dim = w.shape[0]
-    assert in_dim % num_heads == 0, (in_dim, num_heads)
+    if not (in_dim % num_heads == 0):
+        raise AssertionError((in_dim, num_heads))
     per_head = w.reshape(num_heads, in_dim // num_heads, *w.shape[1:])
     norms = jnp.sum(jnp.abs(per_head), axis=tuple(range(1, per_head.ndim)))
     k = max(1, int(num_heads * dense_ratio))

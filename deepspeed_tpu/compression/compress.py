@@ -56,7 +56,8 @@ def student_initialization(student_params: Any, teacher_params: Any,
     else:
         cc = CompressionConfig(ds_config.get("compression_training", ds_config))
     lr = cc.layer_reduction
-    assert lr.enabled, "layer_reduction not enabled"
+    if not (lr.enabled):
+        raise AssertionError("layer_reduction not enabled")
     teacher_flat = {_path_str(p): l for p, l in
                     jax.tree_util.tree_flatten_with_path(teacher_params)[0]}
     prefix = lr.module_name_prefix

@@ -127,7 +127,8 @@ class CompressionScheduler:
         elif kind == "row_pruning":
             fn = lambda x: row_mask(x, group.dense_ratio, sp.method)
         elif kind == "head_pruning":
-            assert group.num_heads, "head_pruning groups need num_heads"
+            if not (group.num_heads):
+                raise AssertionError("head_pruning groups need num_heads")
             fn = lambda x: head_mask(x, group.dense_ratio, group.num_heads,
                                      sp.method)
         else:

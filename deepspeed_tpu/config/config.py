@@ -293,7 +293,8 @@ class DeepSpeedConfig:
 
     # Batch-triple inference: reference ``runtime/config.py`` _configure_train_batch_size.
     def resolve_batch_config(self, dp_world_size: int):
-        assert dp_world_size >= 1
+        if not (dp_world_size >= 1):
+            raise AssertionError('dp_world_size >= 1')
         self.dp_world_size = dp_world_size
         tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                        self.gradient_accumulation_steps)

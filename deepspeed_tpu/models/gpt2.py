@@ -185,9 +185,12 @@ def block_tp_apply(cfg: GPT2Config, tp: int, axis: str,
 
     Returns ``fn(params_local, x, rng) -> y``.
     """
-    assert cfg.split_qkv, "tensor-parallel Block needs split_qkv=True (see GPT2Config)"
-    assert cfg.n_head % tp == 0, (cfg.n_head, tp)
-    assert cfg.dropout == 0.0, "TP stage_fn does not implement attention dropout"
+    if not (cfg.split_qkv):
+        raise AssertionError("tensor-parallel Block needs split_qkv=True (see GPT2Config)")
+    if not (cfg.n_head % tp == 0):
+        raise AssertionError((cfg.n_head, tp))
+    if not (cfg.dropout == 0.0):
+        raise AssertionError("TP stage_fn does not implement attention dropout")
     h_local = cfg.n_head // tp
     dt = cfg.dtype
     f_op, g_op = _tp_conjugate_ops(axis)
@@ -261,8 +264,10 @@ def block_sp_apply(cfg: GPT2Config, sp: int, axis: str):
 
     Returns ``fn(params, x_local, rng) -> y_local``.
     """
-    assert cfg.split_qkv, "seq-parallel Block needs split_qkv=True (see GPT2Config)"
-    assert cfg.dropout == 0.0, "SP stage_fn does not implement attention dropout"
+    if not (cfg.split_qkv):
+        raise AssertionError("seq-parallel Block needs split_qkv=True (see GPT2Config)")
+    if not (cfg.dropout == 0.0):
+        raise AssertionError("SP stage_fn does not implement attention dropout")
     dt = cfg.dtype
 
     def dense(p, x):

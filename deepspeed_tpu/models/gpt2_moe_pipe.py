@@ -88,10 +88,11 @@ def gpt2_moe_pipeline_module(config: GPT2MoEConfig, num_stages: int,
     """Alternating dense/MoE GPT-2 as a pipeline (``n_layer`` transformer layers =
     ``n_layer/2`` dense+MoE pair units; requires ``moe_layer_interval == 2`` and
     even ``n_layer``)."""
-    assert config.moe_layer_interval == 2, \
-        "the pipelined MoE body pairs one dense with one MoE block " \
-        f"(moe_layer_interval=2); got interval {config.moe_layer_interval}"
-    assert config.n_layer % 2 == 0, "n_layer must be even (dense+MoE pairs)"
+    if not (config.moe_layer_interval == 2):
+        raise AssertionError("the pipelined MoE body pairs one dense with one MoE block " \
+        f"(moe_layer_interval=2); got interval {config.moe_layer_interval}")
+    if not (config.n_layer % 2 == 0):
+        raise AssertionError("n_layer must be even (dense+MoE pairs)")
     if config.moe_token_axes:
         # body layers run inside the pipe's manual shard_map where data/fsdp/seq are
         # manual axes — a GSPMD sharding constraint naming them would be an error

@@ -86,8 +86,8 @@ def infer_config(model) -> CausalLMConfig:
     d = _cfg_get(c, "n_embd", "hidden_size")
     n_layer = _cfg_get(c, "n_layer", "num_hidden_layers")
     n_head = _cfg_get(c, "n_head", "num_attention_heads")
-    assert d and n_layer and n_head, \
-        f"auto-TP cannot infer dims from {type(c).__name__}"
+    if not (d and n_layer and n_head):
+        raise AssertionError(f"auto-TP cannot infer dims from {type(c).__name__}")
     n_kv = _cfg_get(c, "num_key_value_heads", "num_kv_heads")
     if getattr(c, "multi_query", False):
         n_kv = 1
@@ -147,7 +147,8 @@ def _split_contiguous_qkv(w: np.ndarray, b: Optional[np.ndarray], d: int,
     """Fused (d + 2·kv_dim, in) torch weight → q/k/v (GQA/MQA contiguous blocks)."""
     if w.shape[0] != d + 2 * kv_dim and w.shape[1] == d + 2 * kv_dim:
         w = w.T    # Conv1D layout (in, out)
-    assert w.shape[0] == d + 2 * kv_dim, (w.shape, d, kv_dim)
+    if not (w.shape[0] == d + 2 * kv_dim):
+        raise AssertionError((w.shape, d, kv_dim))
     qw, kw, vw = np.split(w, [d, d + kv_dim], axis=0)
     qb = kb = vb = None
     if b is not None:
@@ -162,7 +163,8 @@ def _proj(w: np.ndarray, b: Optional[np.ndarray], in_dim: int) -> Dict[str, Any]
     if w.shape[1] == in_dim:          # torch Linear (out, in) — also the square case
         kernel = jnp.asarray(w.T)
     else:
-        assert w.shape[0] == in_dim, (w.shape, in_dim)
+        if not (w.shape[0] == in_dim):
+            raise AssertionError((w.shape, in_dim))
         kernel = jnp.asarray(w)       # Conv1D already (in, out)
     out = {"kernel": kernel}
     if b is not None:
@@ -190,8 +192,8 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
             layers.setdefault(li, {})[k[m.end():]] = v
         else:
             trunk[k] = v
-    assert len(layers) == cfg.n_layer, \
-        (f"auto-TP found {len(layers)} transformer layers, config says "
+    if not (len(layers) == cfg.n_layer):
+        raise AssertionError(f"auto-TP found {len(layers)} transformer layers, config says "
          f"{cfg.n_layer}; keys sample: {list(sd)[:5]}")
 
     params: Dict[str, Any] = {}
@@ -219,8 +221,10 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
         raise ValueError(
             f"auto-TP: unrecognised non-layer parameters {sorted(trunk_left)} — "
             "this architecture needs a named policy")
-    assert "wte" in params, f"auto-TP: no token embedding among {list(trunk)[:8]}"
-    assert "ln_f" in params, f"auto-TP: no final norm among {list(trunk)[:8]}"
+    if not ("wte" in params):
+        raise AssertionError(f"auto-TP: no token embedding among {list(trunk)[:8]}")
+    if not ("ln_f" in params):
+        raise AssertionError(f"auto-TP: no final norm among {list(trunk)[:8]}")
 
     # buffers that are legitimately not parameters of the CausalLM tree
     _IGNORABLE = ("inv_freq", "attn.bias", "attn.masked_bias",
@@ -231,8 +235,8 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
         out: Dict[str, Any] = {}
         for role, ours in [("ln_attn", "ln_attn"), ("ln_mlp", "ln_mlp")]:
             w = _find(lsd, role, "weight", used)
-            assert w is not None, \
-                f"auto-TP: layer {li} missing {role} (keys: {sorted(lsd)[:10]})"
+            if not (w is not None):
+                raise AssertionError(f"auto-TP: layer {li} missing {role} (keys: {sorted(lsd)[:10]})")
             out[ours] = {"scale": jnp.asarray(w)}
             b = _find(lsd, role, "bias", used)
             if b is not None:
@@ -244,8 +248,8 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
                 out[ours] = _proj(w, _find(lsd, role, "bias", used), d)
         else:
             w = _find(lsd, "qkv", "weight", used, raw=True)
-            assert w is not None, \
-                f"auto-TP: layer {li} has neither split nor fused qkv"
+            if not (w is not None):
+                raise AssertionError(f"auto-TP: layer {li} has neither split nor fused qkv")
             b = _find(lsd, "qkv", "bias", used, raw=True)
             if cfg.kv_heads == cfg.n_head:
                 # HF convention for MHA fused qkv is PER-HEAD interleaved
@@ -266,7 +270,8 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
                         out[ours]["bias"] = jnp.asarray(pb)
 
         ow = _find(lsd, "o", "weight", used)
-        assert ow is not None, f"auto-TP: layer {li} missing attention out proj"
+        if not (ow is not None):
+            raise AssertionError(f"auto-TP: layer {li} missing attention out proj")
         out["o_proj"] = _proj(ow, _find(lsd, "o", "bias", used), d)
 
         if cfg.gated_mlp:
@@ -276,10 +281,12 @@ def auto_convert_hf_model(model) -> Tuple[CausalLMConfig, Any]:
                                    _find(lsd, "up", "bias", used), d)
         else:
             fw = _find(lsd, "fc_in", "weight", used)
-            assert fw is not None, f"auto-TP: layer {li} missing mlp in-proj"
+            if not (fw is not None):
+                raise AssertionError(f"auto-TP: layer {li} missing mlp in-proj")
             out["fc_in"] = _proj(fw, _find(lsd, "fc_in", "bias", used), d)
         dw = _find(lsd, "fc_out", "weight", used)
-        assert dw is not None, f"auto-TP: layer {li} missing mlp out-proj"
+        if not (dw is not None):
+            raise AssertionError(f"auto-TP: layer {li} missing mlp out-proj")
         out["fc_out"] = _proj(dw, _find(lsd, "fc_out", "bias", used), cfg.ffn_dim)
 
         # fail-loud: any unconsumed layer parameter means the architecture has

@@ -167,7 +167,8 @@ def topk_select(logits: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     and renormalises the pair with the same 1e-9 clamp (``top2gating``). Owned here so
     serving fast paths (selected-expert weight gather, ``causal_lm._moe_mlp``) share
     routing semantics with the dispatch path by construction."""
-    assert k in (1, 2), "only top-1 and top-2 gating are supported (reference limit)"
+    if not (k in (1, 2)):
+        raise AssertionError("only top-1 and top-2 gating are supported (reference limit)")
     e = logits.shape[-1]
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     if k == 1:
@@ -190,7 +191,8 @@ class TopKGate:
                  eval_capacity_factor: float = 1.0, min_capacity: int = 4,
                  noisy_gate_policy: Optional[str] = None, drop_tokens: bool = True,
                  use_rts: bool = True, top2_2nd_expert_sampling: bool = True):
-        assert k in (1, 2), "only top-1 and top-2 gating are supported (reference limit)"
+        if not (k in (1, 2)):
+            raise AssertionError("only top-1 and top-2 gating are supported (reference limit)")
         self.k = k
         self.capacity_factor = capacity_factor
         self.eval_capacity_factor = eval_capacity_factor

@@ -16,7 +16,6 @@ sites that build them with f-strings lint as ``*`` wildcards against the same
 pattern.
 """
 
-import ast
 import re
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -130,87 +129,32 @@ EMITTER_MODULES = (
     "deepspeed_tpu/observability/metrics.py",
 )
 
-_EMIT_FUNCS = {"write_events", "record_events", "record", "emit", "_write",
-               "counter", "gauge", "histogram"}
-_TAG_RE = re.compile(r"^(serving|router|Train|inference)/[A-Za-z0-9_{}*./]+$")
-
-
-def _literal_tag(node: ast.AST) -> Optional[str]:
-    """Render a Str/JoinedStr AST node to a tag literal (f-string interpolations
-    become ``*``); None when it isn't tag-shaped."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        text = node.value
-    elif isinstance(node, ast.JoinedStr):
-        parts = []
-        for v in node.values:
-            if isinstance(v, ast.Constant) and isinstance(v.value, str):
-                parts.append(v.value)
-            else:
-                parts.append("*")
-        text = "".join(parts)
-    else:
-        return None
-    return text if _TAG_RE.match(text) else None
-
 
 def iter_emission_tags(path: str) -> Iterator[Tuple[str, int]]:
-    """Yield ``(tag_literal, lineno)`` for every tag-shaped string that feeds a
-    metric emission in ``path``: any function that calls one of the emit
-    surfaces (``write_events`` / ``record_events`` / registry ``record`` /
-    ``counter``/``gauge``/``histogram``) contributes every tag-shaped string
-    constant in its body (tags are built as ``(tag, value, step)`` tuples or
-    passed directly; both shapes are covered by the string walk)."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
+    """Yield ``(tag_literal, lineno)`` for every tag-shaped string that feeds
+    a metric emission in ``path``. The walker itself lives in the shared AST
+    lint framework (``analysis.ast_rules.iter_emission_tags``) — this module
+    keeps the schema-facing API and the declaration table."""
+    from ..analysis.ast_rules import iter_emission_tags as _iter
+    yield from _iter(path)
 
-    def calls_emit(fn: ast.AST) -> bool:
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call):
-                fname = None
-                if isinstance(node.func, ast.Attribute):
-                    fname = node.func.attr
-                elif isinstance(node.func, ast.Name):
-                    fname = node.func.id
-                if fname in _EMIT_FUNCS:
-                    return True
-        return False
 
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if not calls_emit(fn):
-            continue
-        body = fn.body
-        # skip the docstring: prose mentions of tags are not emission sites
-        if (body and isinstance(body[0], ast.Expr)
-                and isinstance(body[0].value, ast.Constant)
-                and isinstance(body[0].value.value, str)):
-            body = body[1:]
-        for stmt in body:
-            # constants INSIDE an f-string are fragments, not tags: lint the
-            # rendered JoinedStr pattern, never its pieces
-            fragment_ids = set()
-            for node in ast.walk(stmt):
-                if isinstance(node, ast.JoinedStr):
-                    for sub in ast.walk(node):
-                        if sub is not node:
-                            fragment_ids.add(id(sub))
-            for node in ast.walk(stmt):
-                if id(node) in fragment_ids:
-                    continue
-                tag = _literal_tag(node)
-                if tag is not None:
-                    yield tag, node.lineno
+def emission_tag_rule():
+    """The schema lint as an :class:`~deepspeed_tpu.analysis.ast_rules.AstRule`
+    — the form ``bin/ds-tpu-lint`` runs it in, next to the bare-assert and
+    hot-path-sync rules."""
+    from ..analysis.ast_rules import EmissionTagRule
+    return EmissionTagRule(resolve, EMITTER_MODULES)
 
 
 def lint_emission_sites(repo_root: str) -> List[str]:
     """Every undeclared tag across :data:`EMITTER_MODULES`, as
-    ``"path:line: tag"`` strings (empty list = clean)."""
-    import os
-    problems = []
-    for rel in EMITTER_MODULES:
-        path = os.path.join(repo_root, rel)
-        for tag, lineno in iter_emission_tags(path):
-            if resolve(tag) is None:
-                problems.append(f"{rel}:{lineno}: {tag}")
-    return problems
+    ``"path:line: tag"`` strings (empty list = clean). Runs under the shared
+    AST rule runner (one framework for every source-level rule)."""
+    from ..analysis.ast_rules import run_ast_rules
+    result = run_ast_rules(repo_root, [emission_tag_rule()],
+                           paths=EMITTER_MODULES)
+    # a syntax error in an emitter module surfaces as a runner finding with
+    # no 'tag' detail — report it as a problem, don't crash on it
+    return [f"{f.site}: {f.details.get('tag', f.message)}"
+            for f in result.findings]

@@ -47,7 +47,8 @@ def _get_lib():
 
 
 def _as_flat_f32(a: np.ndarray) -> np.ndarray:
-    assert a.dtype == np.float32, f"host Adam buffers must be fp32, got {a.dtype}"
+    if not (a.dtype == np.float32):
+        raise AssertionError(f"host Adam buffers must be fp32, got {a.dtype}")
     return np.ascontiguousarray(a).reshape(-1)
 
 
@@ -149,7 +150,8 @@ class DeepSpeedCPUAdam:
         leaf ``i``'s in-place update — the offload tier uses it to dispatch that
         leaf's async H2D push while the NEXT leaf's SIMD Adam runs (reference
         cpu_adam.cpp:21-57 tiles copy/compute the same way)."""
-        assert len(grads) == len(self.params)
+        if not (len(grads) == len(self.params)):
+            raise AssertionError('len(grads) == len(self.params)')
         self.step_count += 1
         lr = self.lr if lr is None else float(lr)
         for i, (p, m, v, g) in enumerate(zip(self.params, self.m, self.v, grads)):

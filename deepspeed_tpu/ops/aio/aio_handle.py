@@ -127,11 +127,13 @@ class AsyncIOHandle:
 
     @staticmethod
     def _buf(arr: np.ndarray):
-        assert arr.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
+        if not (arr.flags["C_CONTIGUOUS"]):
+            raise AssertionError("aio buffers must be contiguous")
         return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
 
     def async_pread(self, arr: np.ndarray, path: str, offset: int = 0):
-        assert arr.flags["WRITEABLE"], "read target must be writable"
+        if not (arr.flags["WRITEABLE"]):
+            raise AssertionError("read target must be writable")
         ptr, nbytes = self._buf(arr)
         self._lib.ds_aio_pread(self._h, self._fd(path, False), ptr, nbytes, offset)
 

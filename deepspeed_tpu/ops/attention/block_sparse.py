@@ -302,11 +302,13 @@ def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     unidirectional layouts). Rows whose layout is empty produce zeros.
     """
     b, t, h, d = q.shape
-    assert k.shape == q.shape and v.shape == q.shape, "self-attention only"
+    if not (k.shape == q.shape and v.shape == q.shape):
+        raise AssertionError("self-attention only")
     layout = np.asarray(layout)
-    assert layout.shape[0] == h, (layout.shape, h)
-    assert layout.shape[1] * block == t, \
-        f"layout covers {layout.shape[1] * block} positions, inputs have {t}"
+    if not (layout.shape[0] == h):
+        raise AssertionError((layout.shape, h))
+    if not (layout.shape[1] * block == t):
+        raise AssertionError(f"layout covers {layout.shape[1] * block} positions, inputs have {t}")
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
     tables = build_tables(layout)
 

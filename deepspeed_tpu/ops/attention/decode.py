@@ -111,7 +111,8 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     """
     b, h, d = q.shape
     hk, T = k_cache.shape[1], k_cache.shape[2]
-    assert h % hk == 0, f"query heads {h} must be a multiple of kv heads {hk}"
+    if not (h % hk == 0):
+        raise AssertionError(f"query heads {h} must be a multiple of kv heads {hk}")
     g = h // hk
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
     if d % 128 != 0 and not _interpret():

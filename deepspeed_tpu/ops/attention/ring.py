@@ -133,7 +133,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     b, t, h, d = q.shape
     S = mesh.size(axis_name)
-    assert t % S == 0, f"seq len {t} must divide the seq axis {S}"
+    if not (t % S == 0):
+        raise AssertionError(f"seq len {t} must divide the seq axis {S}")
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
 
     mapped = shard_map(

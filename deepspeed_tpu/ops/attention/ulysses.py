@@ -45,7 +45,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return ring_attention(q, k, v, causal=causal,
                               softmax_scale=softmax_scale, axis_name=axis_name,
                               mesh_spec=mesh)
-    assert t % S == 0, f"seq len {t} must divide the seq axis {S}"
+    if not (t % S == 0):
+        raise AssertionError(f"seq len {t} must divide the seq axis {S}")
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
 
     def ulysses_fn(q_l, k_l, v_l):

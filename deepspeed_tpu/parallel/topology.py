@@ -20,7 +20,8 @@ class ProcessTopology:
     """
 
     def __init__(self, axes: List[str], dims: List[int]):
-        assert len(axes) == len(dims)
+        if not (len(axes) == len(dims)):
+            raise AssertionError('len(axes) == len(dims)')
         self.axes = list(axes)
         self.dims = list(dims)
         self.ProcessCoord = namedtuple("ProcessCoord", axes)
@@ -113,8 +114,9 @@ class PipelineParallelGrid:
         self.pipe_parallel_size = max(1, topology.get_dim("pipe"))
         self.model_parallel_size = max(1, topology.get_dim("model"))
         self.slice_parallel_size = self.model_parallel_size
-        assert self.world_size == (self.data_parallel_size * self.pipe_parallel_size *
-                                   self.model_parallel_size)
+        if not (self.world_size == (self.data_parallel_size * self.pipe_parallel_size *
+                                   self.model_parallel_size)):
+            raise AssertionError('self.world_size == (self.data_parallel_size * self.pipe_parallel_size * self.model_parallel_size)')
         coord = topology.get_coord(global_rank)
         self.stage_id = getattr(coord, "pipe", 0)
         self.data_parallel_id = getattr(coord, "data", 0)

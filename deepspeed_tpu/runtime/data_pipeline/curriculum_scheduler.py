@@ -22,7 +22,8 @@ class CurriculumScheduler:
 
     def __init__(self, config: Dict):
         for key in ("min_difficulty", "max_difficulty", "schedule_type"):
-            assert key in config, f"Curriculum learning requires the config '{key}'"
+            if not (key in config):
+                raise AssertionError(f"Curriculum learning requires the config '{key}'")
         self.state = {
             "min_difficulty": config["min_difficulty"],
             "max_difficulty": config["max_difficulty"],
@@ -36,14 +37,20 @@ class CurriculumScheduler:
         if stype == "fixed_discrete":
             # difficulty has one more entry than max_step: the last difficulty holds
             # for all remaining steps (reference :29-56)
-            assert "difficulty" in sconfig and "max_step" in sconfig
-            assert len(sconfig["difficulty"]) == len(sconfig["max_step"]) + 1
-            assert len(sconfig["max_step"]) > 0
+            if not ("difficulty" in sconfig and "max_step" in sconfig):
+                raise AssertionError('"difficulty" in sconfig and "max_step" in sconfig')
+            if not (len(sconfig["difficulty"]) == len(sconfig["max_step"]) + 1):
+                raise AssertionError('len(sconfig["difficulty"]) == len(sconfig["max_step"]) + 1')
+            if not (len(sconfig["max_step"]) > 0):
+                raise AssertionError('len(sconfig["max_step"]) > 0')
         elif stype in ("fixed_linear", "fixed_root"):
-            assert "total_curriculum_step" in sconfig
-            assert "difficulty_step" in sconfig
+            if not ("total_curriculum_step" in sconfig):
+                raise AssertionError('"total_curriculum_step" in sconfig')
+            if not ("difficulty_step" in sconfig):
+                raise AssertionError('"difficulty_step" in sconfig')
             if stype == "fixed_root":
-                assert "root_degree" in sconfig
+                if not ("root_degree" in sconfig):
+                    raise AssertionError('"root_degree" in sconfig')
             if sconfig["difficulty_step"] % 8 != 0:
                 # TPU note kept from the reference warning: sequence lengths that are
                 # not multiples of 8 hurt matmul tiling (here: MXU lanes)
@@ -102,8 +109,8 @@ class CurriculumScheduler:
         if stype == "fixed_root":
             return self._fixed_root(global_steps)
         if stype == "custom":
-            assert self.custom_get_difficulty is not None, \
-                "custom schedule requires set_custom_get_difficulty()"
+            if not (self.custom_get_difficulty is not None):
+                raise AssertionError("custom schedule requires set_custom_get_difficulty()")
             return self.custom_get_difficulty(global_steps)
         raise RuntimeError(f"Unsupported curriculum schedule type {stype!r}")
 

@@ -23,7 +23,8 @@ def token_drop(x: jnp.ndarray, rng, kept_len: int) -> Tuple[jnp.ndarray, jnp.nda
     matching the reference's single mask per step). ``x``: (B, T, ...) → ((B, kept, ...),
     sorted indices (kept,))."""
     t = x.shape[1]
-    assert 0 < kept_len <= t, (kept_len, t)
+    if not (0 < kept_len <= t):
+        raise AssertionError((kept_len, t))
     idx = jnp.sort(jax.random.permutation(rng, t)[:kept_len])
     return jnp.take(x, idx, axis=1), idx
 

@@ -48,10 +48,13 @@ class DataAnalyzer:
                  save_path: str = "./data_analysis",
                  collate_fn: Optional[Callable] = None,
                  metric_dtypes: Optional[List[Any]] = None):
-        assert len(metric_names) == len(metric_functions) == len(metric_types)
-        assert 0 <= worker_id < num_workers
+        if not (len(metric_names) == len(metric_functions) == len(metric_types)):
+            raise AssertionError('len(metric_names) == len(metric_functions) == len(metric_types)')
+        if not (0 <= worker_id < num_workers):
+            raise AssertionError('0 <= worker_id < num_workers')
         for t in metric_types:
-            assert t in (METRIC_SINGLE, METRIC_ACCUMULATE), t
+            if not (t in (METRIC_SINGLE, METRIC_ACCUMULATE)):
+                raise AssertionError(t)
         self.dataset = dataset
         self.metric_names = metric_names
         self.metric_functions = metric_functions
@@ -84,8 +87,8 @@ class DataAnalyzer:
             for mi, fn in enumerate(self.metric_functions):
                 vals = np.asarray(fn(batch))
                 if self.metric_types[mi] == METRIC_SINGLE:
-                    assert vals.shape[0] == len(idxs), \
-                        (f"metric {self.metric_names[mi]!r} returned "
+                    if not (vals.shape[0] == len(idxs)):
+                        raise AssertionError(f"metric {self.metric_names[mi]!r} returned "
                          f"{vals.shape[0]} values for {len(idxs)} samples")
                 per_metric[mi].append(vals)
         for mi, name in enumerate(self.metric_names):
@@ -110,8 +113,8 @@ class DataAnalyzer:
             shards = []
             for w in range(self.num_workers):
                 f = self._worker_file(name, w)
-                assert os.path.isfile(f), \
-                    f"missing {f} — did worker {w} finish run_map()?"
+                if not (os.path.isfile(f)):
+                    raise AssertionError(f"missing {f} — did worker {w} finish run_map()?")
                 shards.append(np.load(f))
             mdir = os.path.join(self.save_path, name)
             # the shards must stitch to exactly [0, n): a num_workers mismatch
@@ -119,8 +122,8 @@ class DataAnalyzer:
             ranges = sorted((int(s["lo"]), int(s["hi"])) for s in shards)
             covered = ranges[0][0] == 0 and ranges[-1][1] == n and all(
                 a[1] == b[0] for a, b in zip(ranges, ranges[1:]))
-            assert covered, \
-                (f"worker shards {ranges} do not cover [0, {n}) — was run_map "
+            if not (covered):
+                raise AssertionError(f"worker shards {ranges} do not cover [0, {n}) — was run_map "
                  f"executed with a different num_workers than this reduce?")
             if self.metric_types[mi] == METRIC_SINGLE:
                 full = np.zeros(n, self.metric_dtypes[mi])

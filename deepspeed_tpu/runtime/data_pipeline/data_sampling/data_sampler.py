@@ -53,8 +53,10 @@ class DeepSpeedDataSampler:
         self.drop_last = drop_last
         self.np_rng = np.random.default_rng(
             data_efficiency_config.get("seed", 1234))
-        assert self.total_samples > 0 and micro_batch_size > 0
-        assert data_parallel_rank < data_parallel_size
+        if not (self.total_samples > 0 and micro_batch_size > 0):
+            raise AssertionError('self.total_samples > 0 and micro_batch_size > 0')
+        if not (data_parallel_rank < data_parallel_size):
+            raise AssertionError('data_parallel_rank < data_parallel_size')
 
         self.consumed_samples = 0
         self.curriculum_step = 0
@@ -77,10 +79,11 @@ class DeepSpeedDataSampler:
                 self.current_difficulties[metric] = \
                     self.curriculum_schedulers[metric].get_current_difficulty()
                 if self.clustering_type[metric] != CURRICULUM_LEARNING_SINGLE_CLUSTER:
-                    assert metric_values is not None and metric in metric_values, \
-                        f"curriculum metric {metric!r} needs metric_values"
+                    if not (metric_values is not None and metric in metric_values):
+                        raise AssertionError(f"curriculum metric {metric!r} needs metric_values")
                     vals = np.asarray(metric_values[metric])
-                    assert vals.shape[0] == self.one_epoch_total_samples
+                    if not (vals.shape[0] == self.one_epoch_total_samples):
+                        raise AssertionError('vals.shape[0] == self.one_epoch_total_samples')
                     self._metric_values[metric] = vals
                     self._metric_order[metric] = np.argsort(vals, kind="stable")
         self._pool: List[int] = []

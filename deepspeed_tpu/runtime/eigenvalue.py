@@ -26,8 +26,8 @@ class Eigenvalue:
                  tol: float = 1e-2, stability: float = 1e-6,
                  gas_boundary_resolution: int = 1,
                  layer_name: str = "", layer_num: int = 0):
-        assert layer_name and layer_num > 0, \
-            "eigenvalue requires layer_name (stacked subtree path) and layer_num"
+        if not (layer_name and layer_num > 0):
+            raise AssertionError("eigenvalue requires layer_name (stacked subtree path) and layer_num")
         self.verbose = verbose
         self.max_iter = max_iter
         self.tol = tol

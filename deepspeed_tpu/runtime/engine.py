@@ -99,9 +99,10 @@ class DeepSpeedEngine:
                  model_parameters=None, training_data=None, lr_scheduler=None, mpu=None,
                  collate_fn=None, config=None, dont_change_device: bool = False,
                  mesh_spec: Optional[MeshSpec] = None, seed: int = 42):
-        assert model is not None, "deepspeed_tpu.initialize requires a Model"
-        assert isinstance(model, Model), \
-            "model must be deepspeed_tpu.models.Model (see models.base.from_flax)"
+        if not (model is not None):
+            raise AssertionError("deepspeed_tpu.initialize requires a Model")
+        if not (isinstance(model, Model)):
+            raise AssertionError("model must be deepspeed_tpu.models.Model (see models.base.from_flax)")
         dist.init_distributed()
         self.module = model
         self.collate_fn = collate_fn
@@ -893,8 +894,8 @@ class DeepSpeedEngine:
 
         def one(leaf):
             leaf = np.asarray(leaf)
-            assert leaf.shape[0] % gas == 0, \
-                (f"train_batch leading dim {leaf.shape[0]} not divisible by "
+            if not (leaf.shape[0] % gas == 0):
+                raise AssertionError(f"train_batch leading dim {leaf.shape[0]} not divisible by "
                  f"gradient_accumulation_steps {gas}")
             return leaf.reshape(gas, leaf.shape[0] // gas, *leaf.shape[1:])
 
@@ -961,7 +962,7 @@ class DeepSpeedEngine:
         if step_span is not None:
             # tracing-enabled mode pays one sync so the span covers the device
             # work, not just the async dispatch (disabled mode never syncs)
-            jax.block_until_ready(metrics["loss"])
+            jax.block_until_ready(metrics["loss"])  # lint: host-sync-ok (tracer-gated)
             # grad sync is XLA-scheduled inside the step: host wall-time can't
             # split it out, but the trace-time byte accounting can ride the
             # step's trace as a MODELED child span
@@ -992,6 +993,7 @@ class DeepSpeedEngine:
         self._last_metrics = metrics
         self._write_monitor_events(metrics)
         if self._host_steps % self._config.steps_per_print == 0:
+            # lint: host-sync-ok (steps_per_print-gated: syncs only on print steps)
             log_dist(f"step={self._host_steps} loss={float(metrics['loss']):.4f} "
                      f"lr={float(lr):.3e} loss_scale={float(metrics['loss_scale']):.0f}",
                      ranks=[0])
@@ -1192,7 +1194,8 @@ class DeepSpeedEngine:
         across data-parallel devices happens inside XLA when the accumulator's sharded spec
         forces it (stage >= 2) or at update time (psum via replicated spec).
         """
-        assert self._cached_grads is not None, "backward() called before forward()"
+        if not (self._cached_grads is not None):
+            raise AssertionError("backward() called before forward()")
         if loss is not None and loss is not self._cached_loss \
                 and not getattr(self, "_loss_mismatch_warned", False):
             # the cached grads differentiate the loss forward() computed — a
@@ -1227,7 +1230,8 @@ class DeepSpeedEngine:
         self.micro_steps += 1
         if not take_step:
             return
-        assert self._grad_acc is not None, "step() called with no accumulated gradients"
+        if not (self._grad_acc is not None):
+            raise AssertionError("step() called with no accumulated gradients")
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = np.float32(self.get_lr_value())
         if self.offload_enabled:
@@ -1301,15 +1305,19 @@ class DeepSpeedEngine:
         if self.monitor is None or not getattr(self.monitor, "enabled", False):
             return
         step = self._host_steps
+        # lint: host-sync-ok (the documented Train/* monitor-gated sync: the
+        # guard above returns unless a monitor is attached)
         events = [("Train/Samples/train_loss", float(metrics.get("loss", 0.0)), step),
                   ("Train/Samples/lr", self.get_lr_value(), step)]
         if self._config.fp16.enabled:
+            # lint: host-sync-ok (monitor-gated, same guard)
             events.append(("Train/Samples/loss_scale",
                            float(metrics["loss_scale"]), step))
         if spans_total_bytes(self._comm_spans):
             # per-trace bytes-on-wire estimates from the decomposed-collective
             # call sites, snapshotted at THIS engine's first trace (the global
             # accumulator blends every engine's traces in the process)
+            # lint: host-sync-ok (host-side span math, no device value)
             events.append(("Train/Comm/bytes_on_wire",
                            float(spans_total_bytes(self._comm_spans)), step))
             events.append(("Train/Comm/overlap_ratio",

@@ -138,7 +138,8 @@ class WarmupLR(_Schedule):
         self.min_lr = warmup_min_lr
         self.max_lr = warmup_max_lr
         self.warmup_num_steps = max(2, warmup_num_steps)
-        assert warmup_type in ("log", "linear")
+        if not (warmup_type in ("log", "linear")):
+            raise AssertionError('warmup_type in ("log", "linear")')
         self.warmup_type = warmup_type
         self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
 

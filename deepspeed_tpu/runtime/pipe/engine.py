@@ -31,16 +31,16 @@ class PipelineEngine(DeepSpeedEngine):
     def __init__(self, args=None, model: Optional[PipelineModule] = None, optimizer=None,
                  model_parameters=None, training_data=None, lr_scheduler=None, mpu=None,
                  collate_fn=None, config=None, mesh_spec=None, seed: int = 42):
-        assert isinstance(model, PipelineModule), \
-            "PipelineEngine requires a PipelineModule"
+        if not (isinstance(model, PipelineModule)):
+            raise AssertionError("PipelineEngine requires a PipelineModule")
         self.pipeline_module = model
         cfg = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
         # Fold the module's stage count into the mesh (reference: topology implied by
         # PipelineModule + world size).
         if cfg.mesh.pipe in (1, None):
             cfg.mesh.pipe = model.num_stages
-        assert cfg.mesh.pipe == model.num_stages, \
-            (f"config mesh.pipe={cfg.mesh.pipe} != PipelineModule.num_stages="
+        if not (cfg.mesh.pipe == model.num_stages):
+            raise AssertionError(f"config mesh.pipe={cfg.mesh.pipe} != PipelineModule.num_stages="
              f"{model.num_stages}")
         # In-stage tensor parallelism: when the mesh has a tensor axis AND the body
         # layer ships a manual-collective forward (tp_apply_factory — e.g. gpt2_pipe

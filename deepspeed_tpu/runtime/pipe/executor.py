@@ -86,7 +86,8 @@ class EagerPipelineExecutor:
         # init aliases them, ReduceTiedGrads sums their gradients (see train_batch_grads)
         self._tied_keys: List = [
             spec.key if isinstance(spec, TiedLayerSpec) else None for spec in layers]
-        assert sample_input is not None, "sample_input required to trace layer shapes"
+        if not (sample_input is not None):
+            raise AssertionError("sample_input required to trace layer shapes")
 
         # trace shapes + weights for partitioning
         rng = jax.random.PRNGKey(seed)
@@ -211,8 +212,8 @@ class EagerPipelineExecutor:
                     ptr[s] += 1
                     progressed = True
                     st.note_peak()
-            assert progressed, (
-                "schedule deadlock: " +
+            if not (progressed):
+                raise AssertionError("schedule deadlock: " +
                 str([(s, queues[s][ptr[s]]) for s in range(S)
                      if ptr[s] < len(queues[s])]))
 
@@ -221,8 +222,10 @@ class EagerPipelineExecutor:
         if not train:
             return None, None, stats
         M = len(microbatches)
-        assert all(f == M for f in st.fwd_count), st.fwd_count
-        assert all(b == M for b in st.bwd_count), st.bwd_count
+        if not (all(f == M for f in st.fwd_count)):
+            raise AssertionError(st.fwd_count)
+        if not (all(b == M for b in st.bwd_count)):
+            raise AssertionError(st.bwd_count)
         mean_loss = jnp.mean(jnp.stack(st.losses))
         inv_m = 1.0 / M
         grads = [jax.tree_util.tree_map(lambda g: g * inv_m, g) if g is not None else g
@@ -279,8 +282,8 @@ class EagerPipelineExecutor:
                 st.losses.append(loss)
             else:
                 mb_chk, cot = st.pending[s].pop(("cot", cmd.buffer_id))
-                assert mb_chk == mb_id, \
-                    f"grad/act microbatch mismatch: {mb_chk} vs {mb_id}"
+                if not (mb_chk == mb_id):
+                    raise AssertionError(f"grad/act microbatch mismatch: {mb_chk} vs {mb_id}")
                 _, dseg, dx = self._bwd_fn(s, False)(
                     seg_params[s], x, srng(mb_id), None, cot)
             lo, _ = self._segment(s)

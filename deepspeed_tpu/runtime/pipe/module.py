@@ -254,8 +254,8 @@ class PipelineModule:
         # apply_fn (keeps the logits contract when the head emits something else)
         self.apply_transform = None
         self.seed = seed
-        assert sample_input is not None, \
-            "PipelineModule needs sample_input (abstract is fine) to trace layer shapes"
+        if not (sample_input is not None):
+            raise AssertionError("PipelineModule needs sample_input (abstract is fine) to trace layer shapes")
         self.sample_input = sample_input
 
         self._specs = list(layers)
@@ -616,8 +616,8 @@ class PipelineModule:
                 # sequence-sharded tail: per-shard loss contributions reduce to
                 # the global mean via psum inside sp_loss_fn (sum/count over the
                 # seq axis — unequal valid-token counts per shard stay exact)
-                assert self.sp_loss_fn is not None, \
-                    "seq-parallel 1F1B needs PipelineModule.sp_loss_fn"
+                if not (self.sp_loss_fn is not None):
+                    raise AssertionError("seq-parallel 1F1B needs PipelineModule.sp_loss_fn")
                 return self.sp_loss_fn(out, lab, sp_axis)
             if self.loss_fn is not None:
                 return self.loss_fn(out, lab)
@@ -632,9 +632,9 @@ class PipelineModule:
                 # attention all-gathers K/V over the seq axis (GROUPED collective
                 # — a ppermute ring under the pipe-staggered conds is undefined,
                 # see ops/attention/ring.py:allgather_attention_local)
-                assert not body_aux, \
-                    "seq parallelism inside 1F1B does not compose with " \
-                    "aux-loss (MoE) bodies yet"
+                if not (not body_aux):
+                    raise AssertionError("seq parallelism inside 1F1B does not compose with " \
+                    "aux-loss (MoE) bodies yet")
                 key = (tp, sp)
                 if key not in sp_fns:
                     if tp > 1 and tp_axis is not None:
@@ -643,20 +643,20 @@ class PipelineModule:
                         # changes (local heads over seq-gathered K/V)
                         import inspect
                         factory = getattr(body_layer, "tp_apply_factory", None)
-                        assert factory is not None, \
-                            "pipe×tensor×seq needs a body tp_apply_factory"
+                        if not (factory is not None):
+                            raise AssertionError("pipe×tensor×seq needs a body tp_apply_factory")
                         sig = inspect.signature(factory)
-                        assert "sp_axis" in sig.parameters or any(
+                        if not ("sp_axis" in sig.parameters or any(
                             p.kind == inspect.Parameter.VAR_KEYWORD
-                            for p in sig.parameters.values()), \
-                            ("the body's tp_apply_factory does not accept "
+                            for p in sig.parameters.values())):
+                            raise AssertionError("the body's tp_apply_factory does not accept "
                              "sp_axis — pipe×tensor×seq needs one that does "
                              "(e.g. gpt2 blocks, models/gpt2.py:block_tp_apply)")
                         sp_fns[key] = factory(tp, tp_axis, sp_axis=sp_axis)
                     else:
                         factory = getattr(body_layer, "sp_apply_factory", None)
-                        assert factory is not None, \
-                            ("sequence parallelism inside the 1F1B pipeline "
+                        if not (factory is not None):
+                            raise AssertionError("sequence parallelism inside the 1F1B pipeline "
                              "needs a body layer with sp_apply_factory (e.g. "
                              "gpt2_pipe blocks with GPT2Config(split_qkv=True))")
                         sp_fns[key] = factory(sp, sp_axis)
@@ -667,14 +667,14 @@ class PipelineModule:
                     return lambda p, x, r: body_layer.apply_with_aux(p, x, r)
                 return lambda p, x, r: (body_layer.apply(p, x, r),
                                         jnp.float32(0.0))
-            assert not body_aux, \
-                ("in-stage tensor parallelism and aux-loss (MoE) body layers are "
+            if not (not body_aux):
+                raise AssertionError("in-stage tensor parallelism and aux-loss (MoE) body layers are "
                  "not composed yet — run MoE pipelines with tp_axis=None and "
                  "shard experts over the expert axis instead")
             if tp not in tp_fns:
                 factory = getattr(body_layer, "tp_apply_factory", None)
-                assert factory is not None, \
-                    ("tensor parallelism inside the 1F1B pipeline needs a body layer "
+                if not (factory is not None):
+                    raise AssertionError("tensor parallelism inside the 1F1B pipeline needs a body layer "
                      "with tp_apply_factory (e.g. gpt2_pipe blocks with "
                      "split_qkv=True)")
                 tp_fns[tp] = factory(tp, tp_axis)
@@ -736,7 +736,8 @@ class PipelineModule:
                 # and attention all-gathers K/V over the seq axis
                 if sp > 1:
                     t_full = x0_shape.shape[1]
-                    assert t_full % sp == 0, (t_full, sp)
+                    if not (t_full % sp == 0):
+                        raise AssertionError((t_full, sp))
                     tl_sp = t_full // sp
                     s_sp = jax.lax.axis_index(sp_axis)
                     body_shape = (x0_shape.shape[0], tl_sp) + \
@@ -959,7 +960,8 @@ class PipelineModule:
         from ...models.base import Model
         if remat is None:
             remat = self.activation_checkpoint_interval > 0
-        assert schedule in ("1f1b", "gpipe"), schedule
+        if not (schedule in ("1f1b", "gpipe")):
+            raise AssertionError(schedule)
         body_has_aux = bool(getattr(self._layers[self.body_start], "has_aux",
                                     False))
         pipe_loss_1f1b = (self.make_1f1b_loss_fn(mesh_spec, tp_axis=tp_axis,
@@ -1061,5 +1063,6 @@ def _zero_cotangent(tree):
 def _require_global_mesh() -> MeshSpec:
     from ...parallel.mesh import get_global_mesh
     mesh = get_global_mesh()
-    assert mesh is not None, "pipeline loss_fn needs a global mesh (set by the engine)"
+    if not (mesh is not None):
+        raise AssertionError("pipeline loss_fn needs a global mesh (set by the engine)")
     return mesh

@@ -98,7 +98,8 @@ class PipeSchedule:
     """
 
     def __init__(self, micro_batches: int, stages: int, stage_id: int):
-        assert 0 <= stage_id < stages
+        if not (0 <= stage_id < stages):
+            raise AssertionError('0 <= stage_id < stages')
         self.micro_batches = micro_batches
         self.stages = stages
         self.stage_id = stage_id

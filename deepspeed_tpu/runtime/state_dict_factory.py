@@ -127,7 +127,8 @@ def merge_mp_tensors(tensors: List[np.ndarray], axis: int) -> np.ndarray:
 def split_mp_tensor(tensor: np.ndarray, mp_degree: int, axis: int) -> List[np.ndarray]:
     """Split one tensor into MP partitions
     (reference ``MegatronSDLoader.split_state_dict``)."""
-    assert tensor.shape[axis] % mp_degree == 0, (tensor.shape, mp_degree, axis)
+    if not (tensor.shape[axis] % mp_degree == 0):
+        raise AssertionError((tensor.shape, mp_degree, axis))
     return list(np.split(np.asarray(tensor), mp_degree, axis=axis))
 
 

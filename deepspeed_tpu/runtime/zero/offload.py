@@ -252,15 +252,16 @@ class OffloadOptimizerTier:
         leaves, self._treedef = jax.tree_util.tree_flatten(params_device)
         self._shardings = jax.tree_util.tree_leaves(
             param_shardings, is_leaf=lambda x: hasattr(x, "spec"))
-        assert len(self._shardings) == len(leaves)
+        if not (len(self._shardings) == len(leaves)):
+            raise AssertionError('len(self._shardings) == len(leaves)')
         self._shapes = [tuple(l.shape) for l in leaves]
         self.compute_dtype = compute_dtype
         self.kind = kind
         self._partitioned = jax.process_count() > 1
         if self._partitioned:
-            assert grad_shardings is not None, \
-                "multi-process offload needs the gradient shardings (the layout " \
-                "gradients arrive in is the layout masters partition along)"
+            if not (grad_shardings is not None):
+                raise AssertionError("multi-process offload needs the gradient shardings (the layout " \
+                "gradients arrive in is the layout masters partition along)")
             self._grad_shardings = jax.tree_util.tree_leaves(
                 grad_shardings, is_leaf=lambda x: hasattr(x, "spec"))
             # materialise fp32 params in the GRADIENT layout: each process keeps only
@@ -380,8 +381,8 @@ class OffloadOptimizerTier:
             grads = []
             for li, l in enumerate(leaves):
                 pairs = unique_local_shards(l)
-                assert [k for k, _ in pairs] == self._slice_index[li], \
-                    "gradient sharding drifted from the masters partition"
+                if not ([k for k, _ in pairs] == self._slice_index[li]):
+                    raise AssertionError("gradient sharding drifted from the masters partition")
                 grads.extend(np.asarray(d, dtype=np.float32).reshape(-1)
                              for _, d in pairs)
         else:
@@ -530,8 +531,8 @@ class OffloadOptimizerTier:
                                                     template=self.state_dict()))
 
     def state_dict(self) -> dict:
-        assert not self._partitioned, \
-            "multi-process tier checkpoints via save_to/load_from partition files"
+        if not (not self._partitioned):
+            raise AssertionError("multi-process tier checkpoints via save_to/load_from partition files")
         shapes = {f"leaf{i}": np.asarray(s, dtype=np.int64)
                   for i, s in enumerate(self._shapes)}
         sd = {"masters": {f"leaf{i}": m.reshape(self._shapes[i])

@@ -250,9 +250,9 @@ class ParamOffloadCoordinator:
                  nvme_param_path: Optional[str] = None,
                  aio_config: Optional[dict] = None,
                  mesh=None, qat_fn=None):
-        assert segments and segments[0].kind == "first" \
-            and segments[-1].kind == "last", \
-            "segments must run first → mid* → last"
+        if not (segments and segments[0].kind == "first" \
+            and segments[-1].kind == "last"):
+            raise AssertionError("segments must run first → mid* → last")
         self.segments = segments
         self.compute_dtype = compute_dtype
         self.kind = kind
@@ -307,11 +307,11 @@ class ParamOffloadCoordinator:
                 continue
             seg_rng = jax.random.fold_in(rng, si)
             abstract = jax.eval_shape(seg.init_fn, seg_rng)
-            assert len(abstract) == len(seg.init_keys), \
-                f"segment {seg.name}: init_fn must return one subtree per init_key"
+            if not (len(abstract) == len(seg.init_keys)):
+                raise AssertionError(f"segment {seg.name}: init_fn must return one subtree per init_key")
             for key, subtree in zip(seg.init_keys, abstract):
-                assert key not in self.key_treedef, \
-                    f"segment {seg.name}: key {key!r} initialised twice"
+                if not (key not in self.key_treedef):
+                    raise AssertionError(f"segment {seg.name}: key {key!r} initialised twice")
                 leaves, treedef = jax.tree_util.tree_flatten(subtree)
                 self.key_treedef[key] = treedef
                 self.key_shapes[key] = [tuple(l.shape) for l in leaves]
@@ -422,8 +422,11 @@ class ParamOffloadCoordinator:
                     for li, l in enumerate(leaves):
                         pairs = unique_local_shards(l)
                         ids = self._slots_by_leaf[(key, li)]
-                        assert [p[0] for p in pairs] == \
-                            [self._slot_meta[s][2] for s in ids]
+                        if not ([p[0] for p in pairs] == \
+                            [self._slot_meta[s][2] for s in ids]):
+                            raise AssertionError(
+                                "device sharding drifted from the masters "
+                                "partition")
                         for sid, (_, data) in zip(ids, pairs):
                             flat = np.array(data, dtype=np.float32,
                                             copy=True).reshape(-1)
@@ -670,9 +673,9 @@ class ParamOffloadCoordinator:
                 for li, l in enumerate(leaves):
                     pairs = unique_local_shards(l)
                     ids = self._slots_by_leaf[(key, li)]
-                    assert [p[0] for p in pairs] == \
-                        [self._slot_meta[s][2] for s in ids], \
-                        "gradient sharding drifted from the masters partition"
+                    if not ([p[0] for p in pairs] == \
+                        [self._slot_meta[s][2] for s in ids]):
+                        raise AssertionError("gradient sharding drifted from the masters partition")
                     for sid, (_, data) in zip(ids, pairs):
                         flat = np.asarray(data, dtype=np.float32).reshape(-1)
                         if self.nvme_params:
@@ -927,7 +930,8 @@ class ParamOffloadCoordinator:
         ``full_params_host``); optimizer moments are left untouched."""
         for k in self._key_order:
             leaves = jax.tree_util.tree_leaves(tree[k])
-            assert len(leaves) == len(self.key_shapes[k]), f"leaf mismatch for {k!r}"
+            if not (len(leaves) == len(self.key_shapes[k])):
+                raise AssertionError(f"leaf mismatch for {k!r}")
             if self._partitioned:
                 for li, src in enumerate(leaves):
                     flat = np.asarray(src, dtype=np.float32).reshape(

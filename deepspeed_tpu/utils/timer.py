@@ -105,7 +105,8 @@ class SynchronizedWallClockTimer:
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
             memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
-        assert normalizer > 0.0
+        if not (normalizer > 0.0):
+            raise AssertionError('normalizer > 0.0')
         parts = []
         for name in names:
             if name in self.timers:

@@ -52,6 +52,11 @@ def pytest_configure(config):
         "nesting + Perfetto schema, cross-process trace join, histogram "
         "percentiles, /metrics exposition, tag-schema lint, overhead A/B "
         "smoke) — tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "analysis: program-contract analyzer lane (donation "
+        "audit, retrace lint, host-sync detector, loop-invariance pin, "
+        "collective-schema cross-check, AST rules, ds-tpu-lint JSON smoke) "
+        "— tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -68,17 +73,19 @@ def pytest_collection_modifyitems(config, items):
             return 0
         if it.get_closest_marker("observability") is not None:
             return 1                # fast lane: whole suite runs in seconds
+        if it.get_closest_marker("analysis") is not None:
+            return 2                # contract passes over the real programs
         if "inference/serving" in it.nodeid \
                 or it.get_closest_marker("serving_router") is not None \
                 or it.get_closest_marker("prefix_cache") is not None:
-            return 2
-        if it.get_closest_marker("comm_overlap") is not None:
             return 3
-        if it.get_closest_marker("weight_quant") is not None:
+        if it.get_closest_marker("comm_overlap") is not None:
             return 4
-        return 5
+        if it.get_closest_marker("weight_quant") is not None:
+            return 5
+        return 6
 
-    if any(rank(it) < 5 for it in items):
+    if any(rank(it) < 6 for it in items):
         items.sort(key=rank)        # stable: preserves order within each rank
 
 

@@ -307,32 +307,19 @@ def _while_body_dtypes(txt, needle="s8["):
     return bad
 
 
-def _while_body_int8(fn, *args):
-    """True if any while_loop body in ``fn``'s jaxpr consumes int8 values —
-    the program-structure view of 'dequant traced inside the loop body'."""
-    def walk(jaxpr, inside):
-        for v in jaxpr.invars:
-            if inside and getattr(v.aval, "dtype", None) == jnp.int8:
-                return True
-        for eqn in jaxpr.eqns:
-            sub_inside = inside or eqn.primitive.name == "while"
-            for p in eqn.params.values():
-                subs = p if isinstance(p, (list, tuple)) else [p]
-                for s in subs:
-                    inner = getattr(s, "jaxpr", None)
-                    if inner is not None and walk(inner, sub_inside):
-                        return True
-        return False
-
-    return walk(jax.make_jaxpr(fn)(*args).jaxpr, False)
+_INT8_INVAR = lambda aval: getattr(aval, "dtype", None) == jnp.int8  # noqa: E731
 
 
 def test_no_dequant_inside_decode_loop_body():
-    """Satellite 1: on the XLA fallback path the dequant must be hoisted out
-    of the compiled decode loop — int8 operands appear in the module (the
-    params ARE int8) but never inside the loop body. Pinned at BOTH levels:
-    the optimized HLO (what actually runs) and the jaxpr (the structural
-    hoist in ``decode_fns`` — XLA's own LICM must not be what saves us)."""
+    """Satellite 1 of ISSUE 5, re-pointed (ISSUE 11) at the shared
+    ``analysis.assert_loop_invariant`` pass: on the XLA fallback path the
+    dequant must be hoisted out of the compiled decode loop — int8 operands
+    appear in the module (the params ARE int8) but never inside the loop
+    body. Pinned at BOTH levels: the optimized HLO (what actually runs) and
+    the jaxpr (the structural hoist in ``decode_fns`` — XLA's own LICM must
+    not be what saves us)."""
+    from deepspeed_tpu.analysis import (LoopInvarianceError,
+                                        assert_loop_invariant)
     _, _, e = _tiny_engines(bits=8)
     txt = _decode_loop_hlo(e)
     assert "s8[" in txt, "quantized params not present at dispatch"
@@ -348,8 +335,10 @@ def test_no_dequant_inside_decode_loop_body():
             jax.random.PRNGKey(0))
     loop = build_decode_loop(e.module, e._dequant, select, 32,
                              overlap=e.comm_overlap)
-    assert not _while_body_int8(loop, *args), \
-        "dequant traced inside the while_loop body"
+    # require_loop (the default) guards the pin target itself: a refactor
+    # that removes the while_loop raises instead of passing vacuously
+    assert_loop_invariant(loop, args, invar_predicate=_INT8_INVAR,
+                          what="dequant-hoist")
     # negative control: an identity `dequant` pushes the quant nodes into the
     # model, whose CPU fallback dequantizes per-site inside the traced body —
     # the structural inspection must catch that regression shape (XLA LICM
@@ -357,8 +346,9 @@ def test_no_dequant_inside_decode_loop_body():
     # the one that pins OUR hoist)
     bad_loop = build_decode_loop(e.module, lambda p: p, select, 32,
                                  overlap=e.comm_overlap)
-    assert _while_body_int8(bad_loop, *args), \
-        "negative control: in-body dequant went undetected"
+    with pytest.raises(LoopInvarianceError, match="dequant-hoist"):
+        assert_loop_invariant(bad_loop, args, invar_predicate=_INT8_INVAR,
+                              what="dequant-hoist")
 
 
 # ------------------------------------------------------------ bench lane
