@@ -23,19 +23,28 @@ Layers (bottom-up):
   with least-outstanding dispatch, session affinity, the
   LIVE→SUSPECT→DEAD→RECOVERING health state machine, checkpointless request
   retry and SIGTERM graceful drain;
-- :mod:`chaos` — scripted replica kills/stalls for the chaos soak harness;
+- :mod:`autoscale` — :class:`Autoscaler` + :class:`ServiceTimeEstimator`: the
+  elastic control plane — live metrics (queue depth, recent TTFT p95,
+  occupancy) drive replica count with hysteresis + cooldown, and the online
+  service-time estimator powers SLO-aware admission (shed infeasible
+  deadlines at the front door) and the load-adaptive ``retry_after`` hint;
+- :mod:`chaos` — scripted replica kills/stalls/surges for the chaos soak
+  harness;
 - :mod:`telemetry` — :class:`ServingTelemetry`: per-request TTFT/TPOT, queue
   depth, slot occupancy and tokens/sec through ``MonitorMaster``
   (:class:`~.router.RouterTelemetry` adds per-replica health/retry/eviction).
 """
 
+from .autoscale import (Autoscaler, AutoscaleConfig, EstimatorConfig,
+                        ServiceTimeEstimator)
 from .chaos import ChaosEvent, ChaosSchedule, parse_chaos
 from .executor import ChunkedDecodeExecutor, ChunkTimeoutError
 from .kv_pool import SlotKVPool
 from .prefix_cache import PrefixCache, PrefixCacheConfig
-from .router import (EngineReplica, ReplicaDeadError, ReplicaState, Router,
-                     RouterConfig, RouterDrainingError, RouterRequest,
-                     RouterRequestState, RouterTelemetry)
+from .router import (AdmissionDeferredError, AdmissionShedError,
+                     DegradationRung, EngineReplica, ReplicaDeadError,
+                     ReplicaState, Router, RouterConfig, RouterDrainingError,
+                     RouterRequest, RouterRequestState, RouterTelemetry)
 from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
                         RequestHandle, RequestState, ServingConfig)
 from .telemetry import ServingTelemetry
@@ -48,4 +57,6 @@ __all__ = [
     "Router", "RouterConfig", "RouterRequest", "RouterRequestState",
     "RouterTelemetry", "EngineReplica", "ReplicaState", "ReplicaDeadError",
     "RouterDrainingError", "ChaosEvent", "ChaosSchedule", "parse_chaos",
+    "Autoscaler", "AutoscaleConfig", "EstimatorConfig", "ServiceTimeEstimator",
+    "AdmissionShedError", "AdmissionDeferredError", "DegradationRung",
 ]

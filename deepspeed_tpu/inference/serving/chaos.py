@@ -11,18 +11,41 @@ semicolon-separated events, each ``kind:key=val,...``:
                                    # next prefix-slab restore and the suffix
                                    # prefill (prefix-cache soak lane: guards the
                                    # restore path's donation discipline)
+    kill:replica=1,when=draining   # kill replica 1 the moment it is RETIRING
+                                   # (mid-scale-down: the autoscale soak lane —
+                                   # the drain/hand-off parity contract must
+                                   # hold even when the drained replica dies)
     stall:replica=0,when=busy,s=0.6   # wedge replica 0's next chunk for 0.6s
                                       # (the chunk watchdog turns this into a
                                       # ChunkTimeoutError)
     revive:replica=1,at=2.0        # bring a killed replica back (RECOVERING
                                    # probe follows per the router state machine)
+    surge:mult=4,at=1.0,s=2.0      # LOAD hook: multiply the offered arrival
+                                   # rate by 4x for 2s starting at t=1.0 (the
+                                   # loadgen consults load_multiplier(); no
+                                   # replica action)
 
 Events fire at most once. ``at`` is seconds since :class:`ChaosSchedule` start;
 ``when=busy`` fires on the first poll where the target replica has a running
-request. ``when=restore`` (kill only) arms the executor's restore-kill hook on
-the first poll and counts as fired once a cache-hit admission actually trips it
-— it lands *inside* a scheduler step, a boundary ``poll()`` alone can never
-hit. ``poll()`` is called from the driving loop (loadgen / serve).
+request; ``when=draining`` fires on the first poll where the target replica is
+``RETIRING`` (a scale-down drain in progress — pair it with an autoscaler or an
+explicit ``begin_retire``, or the event never fires and ``exhausted`` stays
+False, which the soak asserts). ``when=restore`` (kill only) arms the
+executor's restore-kill hook on the first poll and counts as fired once a
+cache-hit admission actually trips it — it lands *inside* a scheduler step, a
+boundary ``poll()`` alone can never hit. ``surge`` marks itself fired when its
+window opens; :meth:`ChaosSchedule.load_multiplier` is the product of every
+currently-open surge window (1.0 when none). ``poll()`` is called from the
+driving loop (loadgen / serve).
+
+With an elastic replica set (PR 12) events address replicas **by id** (ids are
+monotonic and never reused). A ``when=``-triggered event whose target is not
+currently attached simply waits — the autoscaler may attach/retire it later;
+an ``at=``-triggered event DUE against a detached/unknown replica raises (a
+mistyped id must fail the run, never silently leave the soak fault-free). The
+waiting form's backstop is run-level: the loadgen records ``chaos_exhausted``
+/ ``chaos_unfired`` in the BENCH JSON and FAILS the run when any event never
+fired — a mistyped ``when=`` id cannot pass quietly either.
 """
 
 import time
@@ -31,16 +54,17 @@ from typing import List, Optional
 
 from ...utils.logging import logger
 
-KINDS = ("kill", "stall", "revive")
+KINDS = ("kill", "stall", "revive", "surge")
 
 
 @dataclass
 class ChaosEvent:
-    kind: str                       # kill | stall | revive
-    replica: int
+    kind: str                       # kill | stall | revive | surge
+    replica: int = 0
     at: Optional[float] = None      # seconds after schedule start
-    when: Optional[str] = None      # "busy" | "restore"
-    duration: float = 0.5           # stall seconds
+    when: Optional[str] = None      # "busy" | "restore" | "draining"
+    duration: float = 0.5           # stall seconds / surge window seconds
+    mult: float = 2.0               # surge rate multiplier
     fired: bool = False
     armed: bool = False             # when=restore: hook installed, not yet hit
 
@@ -48,14 +72,27 @@ class ChaosEvent:
         if self.kind not in KINDS:
             raise ValueError(f"unknown chaos kind {self.kind!r} "
                              f"(expected one of {KINDS})")
+        if self.kind == "surge":
+            if self.at is None:
+                raise ValueError("chaos surge needs at=<s>")
+            if self.when is not None:
+                raise ValueError("chaos surge is time-triggered only "
+                                 "(at=<s>,s=<dur>,mult=<x>)")
+            if self.mult <= 0:
+                raise ValueError(f"surge mult must be > 0, got {self.mult}")
+            return
         if self.at is None and self.when is None:
             raise ValueError(f"chaos event {self.kind!r} needs at=<s> or "
-                             "when=busy")
-        if self.when is not None and self.when not in ("busy", "restore"):
+                             "when=busy|restore|draining")
+        if self.when is not None and self.when not in ("busy", "restore",
+                                                       "draining"):
             raise ValueError(f"unknown chaos trigger when={self.when!r}")
         if self.when == "restore" and self.kind != "kill":
             raise ValueError("when=restore is a kill-only trigger (it models "
                              "death inside the restore->prefill window)")
+        if self.when == "draining" and self.kind != "kill":
+            raise ValueError("when=draining is a kill-only trigger (it models "
+                             "death mid-scale-down)")
 
 
 def parse_chaos(spec: str) -> List[ChaosEvent]:
@@ -77,6 +114,7 @@ def parse_chaos(spec: str) -> List[ChaosEvent]:
             replica=int(kv.get("replica", 0)),
             at=float(kv["at"]) if "at" in kv else None,
             when=kv.get("when"),
+            mult=float(kv.get("mult", 2.0)),
             duration=float(kv.get("s", kv.get("duration", 0.5)))))
     return events
 
@@ -88,35 +126,72 @@ class ChaosSchedule:
     events: List[ChaosEvent]
     t0: float = field(default_factory=time.monotonic)
 
-    def _due(self, ev: ChaosEvent, router, now: float) -> bool:
+    def load_multiplier(self, now: Optional[float] = None) -> float:
+        """Product of every open surge window's ``mult`` (1.0 when none) —
+        the loadgen's offered-rate hook, independent of ``fired``."""
+        now = time.monotonic() if now is None else now
+        t = now - self.t0
+        mult = 1.0
+        for ev in self.events:
+            if ev.kind == "surge" and ev.at <= t < ev.at + ev.duration:
+                mult *= ev.mult
+        return mult
+
+    def _due(self, ev: ChaosEvent, router, replica, now: float) -> bool:
         if ev.when == "busy":
             # require a WARM replica (first chunk compiled and completed): the
             # point of when=busy is a deterministic mid-decode hit, and a kill/
             # stall landing inside the first compile is a cold-start test, not
             # a mid-decode one
-            r = router.replicas[ev.replica]
-            return r.running > 0 and getattr(r.scheduler.executor,
-                                             "chunk_warm", True)
-        return now - self.t0 >= ev.at
+            return replica.running > 0 and getattr(replica.scheduler.executor,
+                                                   "chunk_warm", True)
+        if ev.when == "draining":
+            from .router import ReplicaState
+            return router.replica_state(ev.replica) == ReplicaState.RETIRING
+        return True                        # at=: due-ness checked before
+        #   target resolution in poll()
+
+    def _target(self, ev: ChaosEvent, router):
+        """The attached replica an event addresses, by id. ``when=`` events
+        wait for an unattached target (the autoscaler may mint it later);
+        ``at=`` events raise once DUE — a mistyped id must fail the run, not
+        silently leave the soak fault-free, but an autoscaler may still mint
+        the id before the due time."""
+        replica = (router.replica_by_id(ev.replica)
+                   if hasattr(router, "replica_by_id")
+                   else (router.replicas[ev.replica]
+                         if ev.replica < len(router.replicas) else None))
+        if replica is None and ev.when is None:
+            raise ValueError(f"chaos event {ev.kind!r} targets replica "
+                             f"{ev.replica} but it is not attached "
+                             f"(attached ids: "
+                             f"{[r.id for r in router.replicas]})")
+        return replica
 
     def poll(self, router, now: Optional[float] = None) -> List[ChaosEvent]:
         """Fire every due event once; returns the events applied this poll."""
         now = time.monotonic() if now is None else now
         applied = []
         for ev in self.events:
-            if ev.replica >= len(router.replicas):
-                # a mistyped index must fail the run, not silently leave the
-                # soak fault-free ("a chaos run must never degrade to nothing")
-                raise ValueError(f"chaos event {ev.kind!r} targets replica "
-                                 f"{ev.replica} but the router has only "
-                                 f"{len(router.replicas)}")
             if ev.fired:
                 continue
+            if ev.kind == "surge":
+                if now - self.t0 >= ev.at:
+                    ev.fired = True     # multiplier runs off the window, not
+                    applied.append(ev)  # this flag — fired = "window opened"
+                    logger.warning(f"[chaos] surge x{ev.mult} for "
+                                   f"{ev.duration}s")
+                continue
+            if ev.when is None and now - self.t0 < ev.at:
+                continue                # at=: not due yet — don't resolve the
+                #   target early, an autoscaler may mint the id before then
+            replica = self._target(ev, router)
+            if replica is None:
+                continue                # when=-triggered: target not yet born
             if ev.when == "restore":
                 # two-phase: arm the executor hook once; it fires inside the
                 # next cache-hit admission (between restore and suffix
                 # prefill), a window in-between-steps polling cannot reach
-                replica = router.replicas[ev.replica]
                 if replica.scheduler.prefix_cache is None:
                     # without a prefix cache the hook is unreachable and the
                     # soak would pass vacuously ("a chaos run must never
@@ -135,10 +210,9 @@ class ChaosSchedule:
                     ev.fired = True           # the hook was consumed
                     applied.append(ev)
                 continue
-            if not self._due(ev, router, now):
+            if not self._due(ev, router, replica, now):
                 continue
             ev.fired = True
-            replica = router.replicas[ev.replica]
             if ev.kind == "kill":
                 replica.kill()
             elif ev.kind == "revive":
@@ -147,6 +221,8 @@ class ChaosSchedule:
                 replica.scheduler.executor.stall_next(ev.duration)
             logger.warning(f"[chaos] {ev.kind} replica {ev.replica}"
                            + (f" ({ev.duration}s)" if ev.kind == "stall"
+                              else "")
+                           + (" (mid-retire)" if ev.when == "draining"
                               else ""))
             applied.append(ev)
         return applied

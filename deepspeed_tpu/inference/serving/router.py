@@ -28,7 +28,18 @@ unit of recovery on the inference path**:
 - **drain** — SIGTERM (``install_sigterm_drain``) stops admission, lets in-flight
   chunks finish (steps are chunk-granular, so no chunk is ever abandoned
   half-way), evicts what remains with prefixes and hands the queue off as
-  re-submittable specs.
+  re-submittable specs;
+- **elasticity** (PR 12, ``autoscale.py``) — the replica set is dynamic:
+  ``add_replica`` attaches a new replica through the RECOVERING warm-probe
+  path, ``begin_retire`` drains one out gracefully (in-flight work migrates
+  with prefixes at the grace bound — the same bit-exact continuation as death
+  retry), and admission is SLO-aware: a load-adaptive ``retry_after`` rides
+  every rejection, low-priority requests defer on a degraded router, and
+  requests whose estimated completion misses their deadline are shed *at
+  admission* (``AdmissionShedError``) instead of expiring after burning
+  decode steps. The ladder is
+  healthy → defer-low → shed-infeasible → admission-closed
+  (:class:`DegradationRung`), observable as ``router/degradation_rung``.
 
 Replicas here are in-process (:class:`EngineReplica`: one engine + one
 scheduler each — separate meshes in multi-chip deployments), with death/stall
@@ -59,6 +70,7 @@ from ...utils.fault_injection import fault_point, retry_with_backoff
 from ...utils.logging import logger
 from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
                         RequestState, ServingConfig, validate_admission)
+from .telemetry import adaptive_retry_after
 
 
 class ReplicaState(Enum):
@@ -66,11 +78,33 @@ class ReplicaState(Enum):
     SUSPECT = "suspect"          # missed heartbeats; no new dispatches
     DEAD = "dead"                # evicted; circuit open
     RECOVERING = "recovering"    # half-open: one probe request at a time
+    RETIRING = "retiring"        # scale-down drain: no new dispatches, in-
+    #   flight work finishes (or migrates with prefixes at the grace bound)
 
     @property
     def code(self) -> int:
         """Stable numeric code for monitor streams."""
-        return {"live": 0, "suspect": 1, "dead": 2, "recovering": 3}[self.value]
+        return {"live": 0, "suspect": 1, "dead": 2, "recovering": 3,
+                "retiring": 4}[self.value]
+
+
+class DegradationRung(Enum):
+    """The load-shedding ladder, healthy first. Each rung keeps everything the
+    rungs above it do and adds one cheaper-than-serving refusal:
+
+    - ``HEALTHY`` — admit everything admissible;
+    - ``DEFER_LOW`` — low-priority requests (``priority < 0``) are deferred
+      with a retry-after hint (they come back when load drops);
+    - ``SHED_INFEASIBLE`` — the SLO admission check tightens to
+      ``shed_margin`` of the deadline (shed earlier, before the queue makes
+      every estimate a miss);
+    - ``ADMISSION_CLOSED`` — every submission is rejected with a retry-after
+      hint (the queue is at/over ``close_fill``, or the router is draining).
+    """
+    HEALTHY = 0
+    DEFER_LOW = 1
+    SHED_INFEASIBLE = 2
+    ADMISSION_CLOSED = 3
 
 
 class RouterRequestState(Enum):
@@ -94,6 +128,34 @@ class RouterDrainingError(RuntimeError):
         super().__init__("router is draining; admission closed")
 
 
+class AdmissionShedError(QueueFullError):
+    """SLO-aware admission shed: the request's estimated completion misses its
+    deadline, so it is refused *before prefill* instead of expiring after
+    burning decode steps. Subclasses :class:`QueueFullError` so existing
+    backpressure clients keep working; ``retry_after`` is the load-adaptive
+    hint (the estimate may be feasible once the queue drains)."""
+
+    def __init__(self, retry_after: float, estimate_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None):
+        super().__init__(retry_after)
+        self.estimate_s = estimate_s
+        self.deadline_s = deadline_s
+        self.args = (f"shed at admission: estimated completion "
+                     f"{estimate_s if estimate_s is None else round(estimate_s, 3)}s "
+                     f"exceeds deadline {deadline_s}s; "
+                     f"retry after {retry_after:.3f}s",)
+
+
+class AdmissionDeferredError(QueueFullError):
+    """Degradation-ladder defer: a low-priority request turned away while the
+    router is at ``DEFER_LOW`` or worse. Come back after ``retry_after``."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(retry_after)
+        self.args = ("low-priority request deferred under load; "
+                     f"retry after {retry_after:.3f}s",)
+
+
 @dataclass
 class RouterConfig:
     max_queue: int = 256                 # router admission bound
@@ -104,7 +166,19 @@ class RouterConfig:
     max_attempts: int = 3                # dispatches per request (1 + retries)
     dispatch_retries: int = 1            # retry_with_backoff budget per dispatch
     retry_base_delay: float = 0.01
-    retry_after_s: float = 0.25          # backpressure hint
+    retry_after_s: float = 0.25          # backpressure hint FLOOR; the emitted
+    #   hint is load-adaptive (queue depth / observed drain rate), capped below
+    retry_after_max_s: float = 8.0
+    # --- SLO-aware admission + degradation ladder (see DegradationRung) ---
+    slo_admission: bool = False          # shed infeasible-deadline requests
+    #   at admission (needs a warmed-up estimator; never sheds blind)
+    defer_fill: float = 0.75             # queue fill → DEFER_LOW rung
+    shed_fill: float = 0.9               # queue fill → SHED_INFEASIBLE rung
+    close_fill: float = 1.0              # queue fill → ADMISSION_CLOSED rung
+    shed_margin: float = 0.8             # at SHED_INFEASIBLE the estimate must
+    #   fit inside shed_margin * deadline (shed earlier under pressure)
+    retire_grace_s: float = 5.0          # scale-down: in-flight drain window
+    #   before the remainder migrates with prefixes (begin_retire default)
     serving: ServingConfig = field(default_factory=ServingConfig)  # per replica
 
 
@@ -121,6 +195,7 @@ class RouterRequest:
     seed: int
     session: Optional[str]
     arrival: float
+    priority: int = 0                 # < 0 = deferrable under the ladder
     state: RouterRequestState = RouterRequestState.QUEUED
     tokens: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
@@ -178,7 +253,7 @@ class RouterRequest:
                 "max_new_tokens": self.remaining_budget,
                 "eos_token_id": self.eos_token_id,
                 "deadline_s": self.deadline_s, "seed": self.seed,
-                "session": self.session}
+                "session": self.session, "priority": self.priority}
 
 
 @dataclass
@@ -187,6 +262,11 @@ class ReplicaHealth:
     consecutive_failures: int = 0
     died_at: Optional[float] = None
     probe_request: Optional[int] = None   # RouterRequest.id of half-open probe
+    # scale-down lifecycle: `retiring` survives a mid-drain death (state DEAD)
+    # so the retire sweep still detaches the corpse after its eviction
+    retiring: bool = False
+    retiring_since: Optional[float] = None
+    retire_grace_s: float = 5.0
 
 
 class EngineReplica:
@@ -305,11 +385,16 @@ class RouterTelemetry:
         self.handed_off = 0
         self.retried = 0
         self.evicted = 0
+        self.shed = 0                     # refused at admission: infeasible SLO
+        self.deferred = 0                 # refused at admission: low priority
         self.dispatched: Dict[int, int] = {i: 0 for i in range(n_replicas)}
         self.transitions: List = []       # (tick, replica, old, new)
         # bounded distributions (same O(1)-memory contract as ServingTelemetry)
         self.ttft_ms = Histogram()
         self.tpot_ms = Histogram()
+        # recent-TTFT window: the autoscaler's responsive p95 signal (the
+        # cumulative histogram above never forgets a cold start)
+        self.recent_ttft_ms: Deque[float] = deque(maxlen=64)
         # per-emitter feed: cumulative *_total counters contribute deltas so
         # successive routers in one process sum in /metrics
         self._feed = RegistryFeed()
@@ -320,13 +405,21 @@ class RouterTelemetry:
         if self.monitor is not None and getattr(self.monitor, "enabled", False):
             self.monitor.write_events(events)
 
-    def on_step(self, queue_depth: int, replicas, health) -> None:
+    def on_step(self, queue_depth: int, replicas, health,
+                rung: int = 0) -> None:
         self._tick += 1
+        live = sum(1 for r in replicas
+                   if health[r.id].state != ReplicaState.DEAD)
         ev = [("router/queue_depth", float(queue_depth), self._tick),
               ("router/retried_total", float(self.retried), self._tick),
               ("router/evicted_total", float(self.evicted), self._tick),
               ("router/completed_total", float(self.completed), self._tick),
-              ("router/rejected_total", float(self.rejected), self._tick)]
+              ("router/rejected_total", float(self.rejected), self._tick),
+              ("router/shed_total", float(self.shed), self._tick),
+              ("router/deferred_total", float(self.deferred), self._tick),
+              ("router/deadline_miss_total", float(self.expired), self._tick),
+              ("router/degradation_rung", float(rung), self._tick),
+              ("router/live_replicas", float(live), self._tick)]
         for r in replicas:
             ev.append((f"router/replica{r.id}/health",
                        float(health[r.id].state.code), self._tick))
@@ -348,6 +441,12 @@ class RouterTelemetry:
 
     def on_rejected(self) -> None:
         self.rejected += 1
+
+    def on_shed(self) -> None:
+        self.shed += 1
+
+    def on_deferred(self) -> None:
+        self.deferred += 1
 
     def on_evicted(self, n: int = 1) -> None:
         self.evicted += n
@@ -378,6 +477,7 @@ class RouterTelemetry:
         ev = []
         if rr.ttft is not None:
             self.ttft_ms.observe(rr.ttft * 1e3)
+            self.recent_ttft_ms.append(rr.ttft * 1e3)
             ev.append(("router/ttft_ms", rr.ttft * 1e3, self._finished_idx))
         if rr.tpot is not None:
             self.tpot_ms.observe(rr.tpot * 1e3)
@@ -401,6 +501,9 @@ class RouterTelemetry:
             "handed_off": self.handed_off,
             "retried": self.retried,
             "evicted": self.evicted,
+            "shed": self.shed,
+            "deferred": self.deferred,
+            "deadline_missed": self.expired,
             "lost": lost,
             "dispatched": dict(self.dispatched),
             "drain_ms": None if self.drain_s is None else self.drain_s * 1e3,
@@ -433,31 +536,64 @@ class Router:
             r.id: [] for r in self.replicas}
         self._affinity: Dict[str, int] = {}
         self._ids = itertools.count()
+        self._next_replica_id = len(self.replicas)
+        self.retired: List[int] = []          # replica ids detached by retire
+        self._detached_tokens = 0             # tokens served by detached
+        #   replicas: snapshot()'s tokens_total must survive a scale-down
         self._draining = False
         self._drain_started: Optional[float] = None
         self._prev_sigterm = None
         self._tracer = get_tracer()
+        self._rung = DegradationRung.HEALTHY
+        # online service-time model: feeds the SLO admission check and the
+        # load-adaptive retry_after hint (local import: autoscale.py imports
+        # this module at top level)
+        from .autoscale import ServiceTimeEstimator
+        self.estimator = ServiceTimeEstimator()
 
     # ---------------------------------------------------------------- frontend
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                deadline_s: Optional[float] = None, seed: int = 0,
-               session: Optional[str] = None) -> RouterRequest:
+               session: Optional[str] = None,
+               priority: int = 0) -> RouterRequest:
         """Admit a request into the router queue. Raises ``ValueError`` on
-        inadmissible shapes, :class:`QueueFullError` under backpressure, and
-        :class:`RouterDrainingError` once draining has begun."""
+        inadmissible shapes, :class:`QueueFullError` under backpressure (its
+        ``retry_after`` hint is load-adaptive: queue depth over observed drain
+        rate), :class:`AdmissionDeferredError` for low-priority requests on a
+        degraded router, :class:`AdmissionShedError` for deadlines the online
+        estimator says cannot be met (``slo_admission`` only — shed at
+        admission, never expired late), and :class:`RouterDrainingError` once
+        draining has begun."""
         if self._draining:
             raise RouterDrainingError()
         prompt, max_new = validate_admission(
             prompt, max_new_tokens, self.config.serving.default_max_new_tokens,
             self.max_prompt_len, self.cap)
-        if len(self.queue) >= self.config.max_queue:
+        now = time.monotonic()
+        rung = self._update_rung()
+        if len(self.queue) >= self.config.max_queue \
+                or rung == DegradationRung.ADMISSION_CLOSED:
             self.telemetry.on_rejected()
-            raise QueueFullError(self.config.retry_after_s)
+            raise QueueFullError(self.retry_after_hint(now))
+        if rung.value >= DegradationRung.DEFER_LOW.value and priority < 0:
+            self.telemetry.on_deferred()
+            raise AdmissionDeferredError(self.retry_after_hint(now))
+        if self.config.slo_admission and deadline_s is not None:
+            est = self.estimator.estimate_s(max_new, len(self.queue), now)
+            margin = (self.config.shed_margin
+                      if rung.value >= DegradationRung.SHED_INFEASIBLE.value
+                      else 1.0)
+            if est is not None and est > float(deadline_s) * margin:
+                self.telemetry.on_shed()
+                raise AdmissionShedError(self.retry_after_hint(now),
+                                         estimate_s=est,
+                                         deadline_s=float(deadline_s))
         rr = RouterRequest(id=next(self._ids), prompt=prompt,
                            max_new_tokens=max_new, eos_token_id=eos_token_id,
                            deadline_s=deadline_s, seed=int(seed),
-                           session=session, arrival=time.monotonic())
+                           session=session, priority=int(priority),
+                           arrival=now)
         rr._root_span = self._tracer.begin(
             "request", cat=CAT_ROUTER, t0=rr.arrival, tid="router",
             attrs={"request_id": rr.id, "prompt_tokens": int(prompt.size),
@@ -480,8 +616,62 @@ class Router:
         return bool(self.queue) or any(self._dispatched[r.id]
                                        for r in self.replicas)
 
+    @property
+    def retiring_pending(self) -> bool:
+        """Any attached replica mid-scale-down: the driving loop must keep
+        stepping (only :meth:`step`'s retire sweep detaches it), even though
+        ``busy`` is False — idle is exactly when scale-downs happen."""
+        return any(self.health[r.id].retiring for r in self.replicas)
+
     def replica_state(self, replica_id: int) -> ReplicaState:
         return self.health[replica_id].state
+
+    def replica_by_id(self, replica_id: int) -> Optional[EngineReplica]:
+        """The ATTACHED replica with this id, or None (retired replicas are
+        detached from the set; ids are never reused)."""
+        for r in self.replicas:
+            if r.id == replica_id:
+                return r
+        return None
+
+    # -------------------------------------------------- degradation ladder
+    @property
+    def degradation_rung(self) -> DegradationRung:
+        return self._rung
+
+    def _update_rung(self) -> DegradationRung:
+        """Ladder position from queue fill (deterministic, admission-cheap);
+        draining pins ADMISSION_CLOSED. Transitions are logged and traced."""
+        cfg = self.config
+        fill = len(self.queue) / max(1, cfg.max_queue)
+        if self._draining or fill >= cfg.close_fill:
+            rung = DegradationRung.ADMISSION_CLOSED
+        elif fill >= cfg.shed_fill:
+            rung = DegradationRung.SHED_INFEASIBLE
+        elif fill >= cfg.defer_fill:
+            rung = DegradationRung.DEFER_LOW
+        else:
+            rung = DegradationRung.HEALTHY
+        if rung != self._rung:
+            logger.info(f"[router] degradation rung: {self._rung.name} -> "
+                        f"{rung.name} (queue fill {fill:.2f})")
+            span = self._tracer.begin("degradation_rung", cat=CAT_ROUTER,
+                                      tid="router",
+                                      attrs={"from": self._rung.name,
+                                             "to": rung.name,
+                                             "queue_fill": round(fill, 3)})
+            self._tracer.end_span(span)
+            self._rung = rung
+        return rung
+
+    def retry_after_hint(self, now: Optional[float] = None) -> float:
+        """Load-adaptive backpressure hint (see
+        :func:`~.telemetry.adaptive_retry_after`), rated off the router-level
+        completion stream the estimator observes."""
+        cfg = self.config
+        return adaptive_retry_after(cfg.retry_after_s, cfg.retry_after_max_s,
+                                    len(self.queue), cfg.max_queue,
+                                    self.estimator.drain_rate(now))
 
     # -------------------------------------------------------------------- loop
     def step(self, now: Optional[float] = None) -> None:
@@ -500,7 +690,10 @@ class Router:
             self._dispatch(now)
         self._pump(now)
         self._harvest(now)
-        self.telemetry.on_step(len(self.queue), self.replicas, self.health)
+        self._retire_sweep(now)
+        self._update_rung()
+        self.telemetry.on_step(len(self.queue), self.replicas, self.health,
+                               rung=self._rung.value)
 
     def run(self, max_steps: int = 100000) -> Dict:
         """Drive ``step()`` until every admitted request reaches a terminal
@@ -513,10 +706,13 @@ class Router:
 
     def snapshot(self) -> Dict:
         snap = self.telemetry.snapshot()
-        snap["tokens_total"] = sum(
+        snap["tokens_total"] = self._detached_tokens + sum(
             r.scheduler.telemetry.tokens_total for r in self.replicas)
         snap["replica_health"] = {r.id: self.health[r.id].state.value
                                   for r in self.replicas}
+        snap["replicas"] = len(self.replicas)
+        snap["retired_replicas"] = list(self.retired)
+        snap["degradation_rung"] = self._rung.value
         if any(r.scheduler.prefix_cache is not None for r in self.replicas):
             snap["prefix_cache"] = self.prefix_cache_report()
         return snap
@@ -642,12 +838,15 @@ class Router:
         for r in self.replicas:
             h = self.health[r.id]
             if h.state in (ReplicaState.LIVE, ReplicaState.SUSPECT,
-                           ReplicaState.RECOVERING):
+                           ReplicaState.RECOVERING, ReplicaState.RETIRING):
                 # RECOVERING replicas age too: a replica killed mid-probe must
                 # flatline back to DEAD (and release its probe request), not
-                # hold the probe hostage forever. Age is pump-relative: a
-                # router that idled (no pumps) learned nothing — only failing
-                # to respond WHILE pumped counts as a missed heartbeat.
+                # hold the probe hostage forever — and RETIRING replicas age
+                # so a replica killed mid-scale-down still migrates its
+                # in-flight requests via the DEAD eviction path. Age is
+                # pump-relative: a router that idled (no pumps) learned
+                # nothing — only failing to respond WHILE pumped counts as a
+                # missed heartbeat.
                 age = max(0.0, r.last_pump_attempt - r.last_heartbeat)
                 if age > cfg.dead_after_s:
                     self._mark_dead(r, now, f"missed heartbeats for {age:.2f}s")
@@ -713,7 +912,102 @@ class Router:
             self._transition(replica_id, ReplicaState.LIVE)  # breaker closes
 
     def _replica(self, replica_id: int) -> EngineReplica:
-        return self.replicas[replica_id]
+        r = self.replica_by_id(replica_id)
+        if r is None:
+            raise KeyError(f"replica {replica_id} is not attached")
+        return r
+
+    # ----------------------------------------------------- elastic replica set
+    def add_replica(self, engine, warm: bool = True) -> EngineReplica:
+        """Attach a new replica (autoscaler scale-up). Ids are monotonic and
+        never reused — detached ids stay dead in the telemetry history.
+
+        ``warm=True`` (the default, and what the autoscaler uses) admits the
+        replica through the RECOVERING half-open probe path: it serves ONE
+        probe request and only joins the dispatch pool once that succeeds —
+        a replica that cannot serve (bad weights, wedged compile) never takes
+        a batch of real traffic. ``warm=False`` trusts it LIVE immediately."""
+        if self._draining:
+            raise RouterDrainingError()
+        rid = self._next_replica_id
+        self._next_replica_id += 1
+        replica = EngineReplica(rid, engine, self.config.serving)
+        self.replicas.append(replica)
+        self._dispatched[rid] = []
+        self.health[rid] = ReplicaHealth(
+            state=ReplicaState.RECOVERING if warm else ReplicaState.LIVE)
+        self.telemetry.dispatched.setdefault(rid, 0)
+        logger.info(f"[router] replica {rid} attached "
+                    f"({'warm-probe' if warm else 'live'}); "
+                    f"{len(self.replicas)} replica(s)")
+        return replica
+
+    def begin_retire(self, replica_id: int, grace_s: Optional[float] = None,
+                     now: Optional[float] = None) -> None:
+        """Start a graceful scale-down of one replica: no new dispatches, its
+        session affinities release, in-flight requests get ``grace_s`` to
+        finish; whatever remains at the bound is evicted WITH generated
+        prefixes and migrated to the other replicas — the same bit-exact
+        continuation contract as death retry, minus the death."""
+        now = time.monotonic() if now is None else now
+        self._replica(replica_id)             # raises if not attached
+        h = self.health[replica_id]
+        if h.retiring:
+            return
+        # "serving" = attached, not retiring, not DEAD — a corpse is not
+        # capacity, so retiring the last LIVE replica beside a corpse must
+        # refuse too (detaching a DEAD replica itself is always allowed)
+        serving = [r for r in self.replicas
+                   if not self.health[r.id].retiring
+                   and self.health[r.id].state != ReplicaState.DEAD]
+        if any(r.id == replica_id for r in serving) and len(serving) <= 1:
+            raise ValueError("cannot retire the last serving replica")
+        h.retiring = True
+        h.retiring_since = now
+        h.retire_grace_s = float(self.config.retire_grace_s
+                                 if grace_s is None else grace_s)
+        if h.state != ReplicaState.DEAD:
+            self._transition(replica_id, ReplicaState.RETIRING)
+        for sess in [s for s, rid in self._affinity.items()
+                     if rid == replica_id]:
+            del self._affinity[sess]
+
+    def _retire_sweep(self, now: float) -> None:
+        """Advance retiring replicas: detach when empty (or dead — a kill
+        mid-scale-down already migrated its work through ``_mark_dead``);
+        at the grace bound, evict the stragglers with their prefixes and
+        requeue them on the survivors."""
+        for r in [r for r in list(self.replicas)
+                  if self.health[r.id].retiring]:
+            h = self.health[r.id]
+            if h.state == ReplicaState.DEAD:
+                self._detach(r)               # eviction already done
+                continue
+            if not self._dispatched[r.id]:
+                self._detach(r)
+                continue
+            if now - h.retiring_since > h.retire_grace_s:
+                logger.info(f"[router] retire grace expired on replica "
+                            f"{r.id}: migrating "
+                            f"{len(self._dispatched[r.id])} in-flight "
+                            "request(s) with prefixes")
+                r.scheduler.evict_all(reason="scale_down")
+                for rr in self._dispatched[r.id]:
+                    self._requeue(rr, r.id, now, breaker=False)
+                self._dispatched[r.id].clear()
+                self._detach(r)
+
+    def _detach(self, replica: EngineReplica) -> None:
+        self.replicas = [x for x in self.replicas if x.id != replica.id]
+        self._dispatched.pop(replica.id, None)
+        self.retired.append(replica.id)
+        self._detached_tokens += replica.scheduler.telemetry.tokens_total
+        self.health[replica.id].retiring = False
+        for sess in [s for s, rid in self._affinity.items()
+                     if rid == replica.id]:
+            del self._affinity[sess]
+        logger.info(f"[router] replica {replica.id} detached; "
+                    f"{len(self.replicas)} replica(s) remain")
 
     # ---------------------------------------------------------------- dispatch
     def _usable(self, replica: EngineReplica, rr: RouterRequest) -> bool:
@@ -944,4 +1238,10 @@ class Router:
                        "tokens": len(rr.tokens), "attempts": rr.attempts,
                        "retried": rr.retried})
             rr._root_span = None
+        if state == RouterRequestState.FINISHED:
+            # completions feed the online service-time model behind SLO
+            # admission and the adaptive retry_after hint
+            self.estimator.observe(ttft_s=rr.ttft, tpot_s=rr.tpot,
+                                   generated=len(rr.tokens),
+                                   budget=rr.max_new_tokens, now=now)
         self.telemetry.on_finished(rr)

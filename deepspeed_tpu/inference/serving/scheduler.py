@@ -47,7 +47,7 @@ from ...utils.fault_injection import fault_point, retry_with_backoff
 from ...utils.logging import logger
 from .executor import ChunkedDecodeExecutor
 from .prefix_cache import PrefixCache, PrefixCacheConfig
-from .telemetry import ServingTelemetry
+from .telemetry import ServingTelemetry, adaptive_retry_after
 
 
 class RequestState(Enum):
@@ -80,7 +80,9 @@ class ServingConfig:
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
-    retry_after_s: float = 0.25         # backpressure hint
+    retry_after_s: float = 0.25         # backpressure hint FLOOR (the emitted
+    #   hint is load-adaptive: queue depth / observed drain rate)
+    retry_after_max_s: float = 8.0
     transient_retries: int = 2          # retry_with_backoff budget per dispatch
     retry_base_delay: float = 0.02
     base_seed: int = 0
@@ -200,7 +202,7 @@ class ContinuousBatchingScheduler:
             self.executor.max_prompt_len, self.cap)
         if len(self.queue) >= self.config.max_queue:
             self.telemetry.on_rejected()
-            raise QueueFullError(self.config.retry_after_s)
+            raise QueueFullError(self.retry_after_hint())
         handle = RequestHandle(
             id=next(self._ids), prompt=prompt, max_new_tokens=max_new,
             eos_token_id=eos_token_id, deadline_s=deadline_s, seed=int(seed),
@@ -216,6 +218,14 @@ class ContinuousBatchingScheduler:
     @property
     def queue_depth(self) -> int:
         return len(self.queue)
+
+    def retry_after_hint(self, now: Optional[float] = None) -> float:
+        """Load-adaptive backpressure hint (see
+        :func:`~.telemetry.adaptive_retry_after`)."""
+        cfg = self.config
+        return adaptive_retry_after(cfg.retry_after_s, cfg.retry_after_max_s,
+                                    len(self.queue), cfg.max_queue,
+                                    self.telemetry.drain_rate(now))
 
     @property
     def active_requests(self) -> List[RequestHandle]:

@@ -17,6 +17,13 @@ Two modes over the same frontend (a single scheduler, or — with
 ``--prefix-cache`` enables the radix prompt-prefix KV cache (per replica:
 shared system prompts skip prefill, greedy outputs bit-identical to cache-off;
 ``--prefix-cache-mb`` bounds the slab HBM budget).
+``--autoscale --min-replicas N --max-replicas M`` attaches the elastic control
+plane (``serving.autoscale``): replica count follows queue depth / recent TTFT
+p95 with hysteresis + cooldown, scale-up warms through the RECOVERING probe,
+scale-down retires gracefully (in-flight requests migrate bit-identically).
+``--slo-admission`` sheds requests whose estimated completion misses their
+``deadline_s`` at admission (an ``{"error": ...}`` line with the retry-after
+hint) instead of letting them expire after burning decode steps.
 ``--chaos "<spec>"`` schedules replica kills/stalls (see ``serving.chaos``), and
 a ``DS_TPU_FAULT_SPEC`` env (``utils.fault_injection.fault_env``) is armed at
 startup — the hook chaos tests use to inject deterministically into
@@ -92,7 +99,8 @@ def _result_line(h) -> str:
     })
 
 
-def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None):
+def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None,
+                 autoscaler=None):
     """Streaming serve loop: requests are admitted as their lines arrive (a
     reader thread feeds a queue, so a client may keep the pipe open and read
     results before sending more) and each result is emitted the moment its
@@ -108,6 +116,7 @@ def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None):
     import queue as _queue
     import threading
 
+    from .router import AdmissionShedError
     from .scheduler import QueueFullError
     inp = inp if inp is not None else sys.stdin
     is_router = hasattr(sched, "replicas")
@@ -127,6 +136,8 @@ def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None):
             break                            # SIGTERM: graceful drain below
         if chaos is not None:
             chaos.poll(sched)
+        if autoscaler is not None:
+            autoscaler.step()
         while True:                          # drain whatever the reader has
             try:
                 line = lines.get_nowait()
@@ -146,16 +157,30 @@ def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None):
                               seed=req.get("seed", 0))
                 if is_router:
                     kwargs["session"] = req.get("session")
+                    kwargs["priority"] = req.get("priority", 0)
                 handles.append(sched.submit(
                     np.asarray(req["prompt"], np.int32), **kwargs))
                 pending.pop(0)
+            except AdmissionShedError as e:  # SLO shed is TERMINAL for this
+                # line: its deadline re-anchors at every resubmission, so a
+                # deadline below bare service time would re-shed forever and
+                # head-of-line-block every later request — fail it with the
+                # hint and keep serving (checked before its QueueFullError
+                # parent, which IS worth resubmitting)
+                out.write(json.dumps({"error": f"shed: {e}",
+                                      "retry_after": e.retry_after,
+                                      "line": pending.pop(0)[:200]}) + "\n")
             except QueueFullError as e:      # backpressure: drain, then resubmit
                 not_before = time.monotonic() + e.retry_after
                 break
             except Exception as e:           # bad line: fail it, keep serving
                 out.write(json.dumps({"error": f"{type(e).__name__}: {e}",
                                       "line": pending.pop(0)[:200]}) + "\n")
-        if sched.busy:
+        if sched.busy or (is_router and getattr(sched, "retiring_pending",
+                                                False)):
+            # an idle scale-down still needs steps: only the router's retire
+            # sweep detaches a RETIRING replica, and idle is exactly when
+            # scale-downs happen
             sched.step()
         elif not eof or pending:
             time.sleep(0.01)                 # idle: await input, don't spin
@@ -254,6 +279,17 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queue", type=int, default=32)
     ap.add_argument("--replicas", type=int, default=1,
                     help=">=2 serves through the multi-replica router")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="metrics-driven autoscaling: start at --min-replicas "
+                         "and let the control plane scale within "
+                         "[--min-replicas, --max-replicas] from queue depth "
+                         "and recent TTFT p95")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--slo-admission", action="store_true",
+                    help="SLO-aware admission: requests whose estimated "
+                         "completion misses their deadline_s are shed at "
+                         "admission with a load-adaptive retry_after")
     ap.add_argument("--chaos", default=None,
                     help="chaos spec, e.g. 'kill:replica=1,at=0.5;"
                          "stall:replica=0,when=busy,s=0.6' (see serving.chaos)")
@@ -332,11 +368,23 @@ def main(argv=None) -> int:
                                 prefix_cache=prefix_cfg)
     monitor = _make_monitor(args)
     chaos = None
-    if args.replicas > 1:
+    autoscaler = None
+    # SLO admission lives on the Router: a bare --slo-admission must not
+    # silently degrade to the admission-blind single-scheduler path
+    if args.replicas > 1 or args.autoscale or args.slo_admission:
+        from .autoscale import Autoscaler, AutoscaleConfig
         from .chaos import ChaosSchedule, parse_chaos
         from .router import Router, RouterConfig
-        engines = _build_engines(args, args.replicas)
-        rcfg = RouterConfig(serving=serving_cfg, max_queue=args.max_queue)
+        if args.autoscale and args.replicas > args.max_replicas:
+            raise SystemExit(f"--replicas {args.replicas} exceeds "
+                             f"--max-replicas {args.max_replicas}")
+        # with --autoscale an explicit --replicas sets the STARTING size
+        # (bounded below by --min-replicas), it is not silently discarded
+        n0 = (max(args.min_replicas, args.replicas) if args.autoscale
+              else args.replicas)
+        engines = _build_engines(args, n0)
+        rcfg = RouterConfig(serving=serving_cfg, max_queue=args.max_queue,
+                            slo_admission=args.slo_admission)
         if args.selftest:
             # tight health thresholds: the kill-and-retry round trip should
             # prove itself in ~a second, not wait out production timeouts
@@ -344,6 +392,11 @@ def main(argv=None) -> int:
             rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
         front = Router(engines, rcfg, monitor=monitor)
         front.install_sigterm_drain()      # SIGTERM = graceful drain
+        if args.autoscale:
+            autoscaler = Autoscaler(
+                front, lambda: _build_engine(args, params=engines[0].params),
+                AutoscaleConfig(min_replicas=args.min_replicas,
+                                max_replicas=args.max_replicas))
         if args.chaos:
             chaos = ChaosSchedule(parse_chaos(args.chaos))
         if args.selftest:
@@ -363,7 +416,7 @@ def main(argv=None) -> int:
             print(json.dumps({"selftest_ok": ok, **snap}))
             _obs_epilogue()
             return 0 if ok else 1
-    snap = _serve_stdin(front, chaos=chaos)
+    snap = _serve_stdin(front, chaos=chaos, autoscaler=autoscaler)
     print(json.dumps(snap), file=sys.stderr)
     _obs_epilogue()
     return 0

@@ -23,9 +23,40 @@ percentile keys, now bucket-derived.
 """
 
 import time
-from typing import Dict
+from collections import deque
+from typing import Dict, Iterable, Optional
 
 from ...observability.metrics import Histogram, RegistryFeed
+
+
+def window_rate(times: Iterable[float], now: float,
+                horizon_s: float = 10.0) -> Optional[float]:
+    """Events per second over the trailing ``horizon_s`` window, or None
+    without fresh evidence (fewer than two events inside the horizon — a
+    stale window must never report an ancient rate). THE drain-rate helper:
+    scheduler/router backpressure hints and the autoscale estimator all rate
+    their completion streams through this one function."""
+    recent = [t for t in times if t >= now - horizon_s]
+    if len(recent) < 2 or now <= recent[0]:
+        return None
+    return (len(recent) - 1) / max(now - recent[0], 1e-6)
+
+
+def adaptive_retry_after(floor_s: float, cap_s: float, queue_depth: int,
+                         max_queue: int,
+                         drain_rate: Optional[float]) -> float:
+    """Load-adaptive backpressure hint: estimated seconds until one queue
+    slot drains (``(depth + 1) / drain_rate``), a fill-scaled multiple of
+    the floor before any drain evidence exists; bounded to
+    ``[floor_s, cap_s]`` so one bad estimate cannot park every client for
+    minutes. A static hint convoys rejected clients back in lockstep at
+    exactly the wrong moment — this one stretches with the backlog. Shared
+    by the scheduler and the router (the two QueueFullError emitters)."""
+    if drain_rate is None or drain_rate <= 0:
+        hint = floor_s * (1.0 + queue_depth / max(1, max_queue))
+    else:
+        hint = (queue_depth + 1) / drain_rate
+    return float(min(max(hint, floor_s), cap_s))
 
 
 class ServingTelemetry:
@@ -58,6 +89,9 @@ class ServingTelemetry:
         self.prefix_misses = 0
         self.prefix_hit_tokens = 0
         self._prefix_stats = None    # latest PrefixCache.stats() gauge set
+        # completion timestamps (bounded): the observed drain rate behind the
+        # load-adaptive QueueFullError.retry_after hint
+        self._finish_times = deque(maxlen=64)
         self._t_start = time.perf_counter()
 
     # ------------------------------------------------------------------- emits
@@ -124,6 +158,7 @@ class ServingTelemetry:
             return
         self.completed += 1
         self._finished_idx += 1
+        self._finish_times.append(time.monotonic())
         events = []
         if handle.ttft is not None:
             self.ttft_ms.observe(handle.ttft * 1e3)
@@ -134,6 +169,12 @@ class ServingTelemetry:
             events.append(("serving/tpot_ms", handle.tpot * 1e3,
                            self._finished_idx))
         self._write(events)
+
+    def drain_rate(self, now: Optional[float] = None,
+                   horizon_s: float = 10.0) -> Optional[float]:
+        """Recent completions per second, or None without fresh evidence."""
+        now = time.monotonic() if now is None else now
+        return window_rate(self._finish_times, now, horizon_s)
 
     # --------------------------------------------------------------- aggregate
     def snapshot(self) -> Dict:

@@ -46,9 +46,24 @@ TAGS: Dict[str, Tuple[str, str]] = {
     "router/drain_ms": (GAUGE, "graceful-drain wall time"),
     "router/ttft_ms": (HISTOGRAM, "end-to-end TTFT across retry attempts"),
     "router/tpot_ms": (HISTOGRAM, "end-to-end TPOT across retry attempts"),
-    "router/replica{i}/health": (GAUGE, "replica state code (0 live .. 3 recovering)"),
+    "router/replica{i}/health": (GAUGE, "replica state code (0 live .. 4 retiring)"),
     "router/replica{i}/outstanding": (GAUGE, "running + queued at the replica"),
     "router/replica{i}/prefix_hit_rate": (GAUGE, "per-replica prefix hit rate"),
+    # --------------------------------------------- elastic control plane (PR 12)
+    "router/live_replicas": (GAUGE, "attached non-DEAD replicas per tick"),
+    "router/target_replicas": (GAUGE, "autoscaler's desired replica count"),
+    "router/shed_total": (COUNTER, "requests shed at admission (infeasible "
+                                   "deadline under SLO-aware admission)"),
+    "router/deferred_total": (COUNTER, "low-priority requests deferred under "
+                                       "the degradation ladder"),
+    "router/deadline_miss_total": (COUNTER, "post-admission deadline expiries"),
+    "router/degradation_rung": (GAUGE, "degradation ladder rung (0 healthy, "
+                                       "1 defer-low, 2 shed-infeasible, "
+                                       "3 admission-closed)"),
+    "autoscale/scale_up_total": (COUNTER, "replicas added by the autoscaler"),
+    "autoscale/scale_down_total": (COUNTER, "replicas retired by the autoscaler"),
+    "autoscale/replica_seconds": (COUNTER, "integrated attached-replica "
+                                           "seconds (provisioned capacity)"),
     # ---------------------------------------------------------------- training
     "Train/Samples/train_loss": (GAUGE, "loss at each optimizer step"),
     "Train/Samples/lr": (GAUGE, "learning rate at each optimizer step"),
@@ -124,6 +139,7 @@ def is_declared(tag: str) -> bool:
 EMITTER_MODULES = (
     "deepspeed_tpu/inference/serving/telemetry.py",
     "deepspeed_tpu/inference/serving/router.py",
+    "deepspeed_tpu/inference/serving/autoscale.py",
     "deepspeed_tpu/runtime/engine.py",
     "deepspeed_tpu/inference/engine.py",
     "deepspeed_tpu/observability/metrics.py",

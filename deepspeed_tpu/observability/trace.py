@@ -39,6 +39,7 @@ from typing import Dict, List, Optional
 CAT_SERVING = "serving"
 CAT_ROUTER = "router"
 CAT_TRAIN = "train"
+CAT_AUTOSCALE = "autoscale"
 
 
 class SpanContext:

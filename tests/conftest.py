@@ -57,6 +57,11 @@ def pytest_configure(config):
         "audit, retrace lint, host-sync detector, loop-invariance pin, "
         "collective-schema cross-check, AST rules, ds-tpu-lint JSON smoke) "
         "— tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "serving_autoscale: elastic control plane lane "
+        "(autoscaler scale-up/down, hysteresis, SLO admission shed-vs-"
+        "expire, degradation ladder, drain-parity on scale-down, "
+        "chaos-during-scale, loadgen schedule smoke) — tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -77,7 +82,8 @@ def pytest_collection_modifyitems(config, items):
             return 2                # contract passes over the real programs
         if "inference/serving" in it.nodeid \
                 or it.get_closest_marker("serving_router") is not None \
-                or it.get_closest_marker("prefix_cache") is not None:
+                or it.get_closest_marker("prefix_cache") is not None \
+                or it.get_closest_marker("serving_autoscale") is not None:
             return 3
         if it.get_closest_marker("comm_overlap") is not None:
             return 4

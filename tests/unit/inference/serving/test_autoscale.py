@@ -1,0 +1,564 @@
+"""Elastic serving control plane tests: the autoscaler's scale-up (RECOVERING
+warm-probe path) and scale-down (graceful retire with bit-exact in-flight
+migration), hysteresis + cooldown, the online service-time estimator, SLO-aware
+admission (shed-at-admission vs expire-late accounting), the degradation
+ladder, load-adaptive ``retry_after`` (convoy behavior under surge), chaos
+during scale events (``kill:replica=i,when=draining``, ``surge``), and the
+loadgen schedule-arrival smoke.
+
+Determinism notes: replica weights are shared (bit-identical), so every
+migration test asserts exact token equality against a per-request ``generate``
+reference — a request evicted by scale-down continues its greedy stream
+bit-identically on the survivor, the same contract as death retry. Autoscaler
+timing is driven through the injectable ``now`` of ``Autoscaler.step`` wherever
+possible.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.serving import (AdmissionDeferredError,
+                                             AdmissionShedError,
+                                             AutoscaleConfig, Autoscaler,
+                                             ChaosEvent, ChaosSchedule,
+                                             ContinuousBatchingScheduler,
+                                             DegradationRung, EstimatorConfig,
+                                             QueueFullError, ReplicaState,
+                                             Router, RouterConfig,
+                                             RouterRequestState,
+                                             ServiceTimeEstimator,
+                                             ServingConfig, parse_chaos)
+from deepspeed_tpu.models.causal_lm import gpt2_cfg
+
+pytestmark = pytest.mark.serving_autoscale
+
+TINY = dict(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+            dtype=jnp.float32)
+CAP = 48
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+
+@pytest.fixture(scope="module")
+def base_engine():
+    return InferenceEngine(gpt2_cfg(**TINY),
+                           ds.inference.DeepSpeedInferenceConfig(
+                               dtype="float32", max_out_tokens=CAP))
+
+
+@pytest.fixture(scope="module")
+def spare_engines(base_engine):
+    """Pre-built factory engines (shared weights): scale-up tests reuse these
+    so the suite pays engine construction once, like a warm fleet would."""
+    return [InferenceEngine(gpt2_cfg(**TINY),
+                            ds.inference.DeepSpeedInferenceConfig(
+                                dtype="float32", max_out_tokens=CAP),
+                            params=base_engine.params) for _ in range(3)]
+
+
+def make_router(engines, **over):
+    serving = over.pop("serving", None) or ServingConfig(
+        slots=2, chunk_size=3, max_seq_len=CAP, retry_base_delay=0.001)
+    rcfg = RouterConfig(serving=serving, suspect_after_s=0.04,
+                        dead_after_s=0.12, recover_after_s=0.2,
+                        breaker_threshold=2, max_attempts=4,
+                        retry_base_delay=0.001)
+    for k, v in over.items():
+        setattr(rcfg, k, v)
+    return Router(engines, rcfg)
+
+
+def make_autoscaler(router, spares, **over):
+    spares = list(spares)
+
+    def factory():
+        attached = {id(r.engine) for r in router.replicas}
+        free = [e for e in spares if id(e) not in attached]
+        if not free:
+            raise AssertionError("spare engine pool exhausted")
+        return free[0]
+
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3,
+                          eval_interval_s=0.0, queue_high_per_replica=1.0,
+                          breach_evals=1, idle_evals=2, cooldown_s=0.0,
+                          occupancy_low=0.35, retire_grace_s=0.2)
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return Autoscaler(router, factory, cfg)
+
+
+def _prompts(seed=0, sizes=(8, 5, 3, 6)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, TINY["vocab_size"], size=s).astype(np.int32)
+            for s in sizes]
+
+
+def _ref(engine, prompt, max_new):
+    out = np.asarray(engine.generate(prompt[None, :], max_new_tokens=max_new))
+    return out[0, prompt.size:]
+
+
+# ------------------------------------------------------------------ estimator
+def test_estimator_unit():
+    est = ServiceTimeEstimator(EstimatorConfig(alpha=0.5, min_observations=2))
+    assert est.estimate_s(10) is None          # never sheds blind
+    assert est.drain_rate(now=0.0) is None
+    est.observe(ttft_s=0.2, tpot_s=0.01, generated=5, budget=10, now=0.0)
+    assert not est.ready                       # 1 obs < min_observations
+    assert est.estimate_s(10, queue_depth=5, now=0.5) is None
+    est.observe(ttft_s=0.2, tpot_s=0.01, generated=5, budget=10, now=1.0)
+    assert est.ready
+    # ttft 0.2, tpot 0.01, eos_frac 0.5 -> expected tokens 5, serve 0.25s
+    assert est.expected_tokens(10) == pytest.approx(5.0)
+    assert est.estimate_s(10, queue_depth=0, now=1.0) == pytest.approx(0.25)
+    # drain rate: 2 finishes over 1s -> 1/s; queue of 3 adds 3s of wait
+    assert est.drain_rate(now=1.0) == pytest.approx(1.0)
+    assert est.estimate_s(10, queue_depth=3, now=1.0) == pytest.approx(3.25)
+    # EWMA moves toward new evidence
+    est.observe(ttft_s=0.4, tpot_s=0.01, generated=10, budget=10, now=2.0)
+    assert est.ttft_s == pytest.approx(0.3)
+    assert est.eos_frac == pytest.approx(0.75)
+    # stale window: far-future now has no fresh completions -> None
+    assert est.drain_rate(now=100.0) is None
+    snap = est.snapshot()
+    assert snap["ready"] and snap["observations"] == 3
+
+
+def test_estimator_never_sheds_blind(base_engine):
+    """SLO admission with a cold estimator admits everything (no evidence =
+    no shedding), even for absurd deadlines."""
+    router = make_router([base_engine], slo_admission=True)
+    p = _prompts(1, sizes=(5,))[0]
+    h = router.submit(p, max_new_tokens=4, deadline_s=1e-6)
+    # admitted (not shed); it will expire post-admission, which is exactly
+    # the failure mode a WARMED estimator prevents
+    assert h.state == RouterRequestState.QUEUED
+    router.run()
+    assert router.telemetry.shed == 0
+
+
+# ---------------------------------------------------------------- scale up
+def test_scale_up_through_recovering_probe(base_engine, spare_engines):
+    router = make_router([base_engine])
+    asc = make_autoscaler(router, spare_engines, breach_evals=2)
+    rng = np.random.default_rng(2)
+    ps = [rng.integers(0, 96, size=6).astype(np.int32) for _ in range(8)]
+    hs = [router.submit(p, max_new_tokens=12) for p in ps]
+    for _ in range(300):
+        asc.step()
+        router.step()
+        if all(h.done for h in hs):
+            break
+    assert all(h.state == RouterRequestState.FINISHED for h in hs)
+    assert asc.scale_ups >= 1
+    assert len(router.replicas) >= 2
+    # the new replica entered through the half-open warm probe: its health
+    # transition log shows recovering -> live, never a cold LIVE insertion
+    new_ids = [r.id for r in router.replicas if r.id != 0]
+    seen = [(t[1], t[2].value, t[3].value)
+            for t in router.telemetry.transitions]
+    assert any((rid, "recovering", "live") in seen for rid in new_ids)
+    for h, p in zip(hs, ps):
+        np.testing.assert_array_equal(h.result(), _ref(base_engine, p, 12))
+    assert router.snapshot()["lost"] == 0
+
+
+def test_hysteresis_and_cooldowns(base_engine, spare_engines):
+    """breach_evals consecutive breaches are required, one calm evaluation
+    resets the streak, and the up-cooldown blocks back-to-back scale-ups."""
+    router = make_router([base_engine], max_queue=64)
+    asc = make_autoscaler(router, spare_engines, breach_evals=3,
+                          cooldown_s=100.0, up_cooldown_s=50.0)
+    p = _prompts(3, sizes=(5,))[0]
+    for _ in range(6):                       # deep queue, never stepped
+        router.submit(p, max_new_tokens=4)
+    t = 1000.0
+    assert asc.step(now=t + 1) is None       # breach 1
+    assert asc.step(now=t + 2) is None       # breach 2
+    # a calm evaluation (queue drained) resets the streak
+    drained = list(router.queue)
+    router.queue.clear()
+    assert asc.step(now=t + 3) is None
+    router.queue.extend(drained)
+    assert asc.step(now=t + 4) is None       # breach 1 again
+    assert asc.step(now=t + 5) is None       # breach 2
+    assert asc.step(now=t + 6) == "up"       # breach 3 -> scale up
+    assert asc.scale_ups == 1 and len(router.replicas) == 2
+    # still breaching, but inside the up-cooldown: no second scale-up — the
+    # streak keeps accruing, so the action fires the moment cooldown lifts
+    for dt in (7, 8, 9):
+        assert asc.step(now=t + dt) is None
+    assert asc.step(now=t + 57) == "up"
+    assert len(router.replicas) == 3
+    router.run()
+    assert router.snapshot()["lost"] == 0
+
+
+# -------------------------------------------------------------- scale down
+def test_scale_down_retires_idle_replica(base_engine, spare_engines):
+    router = make_router([base_engine, spare_engines[0]])
+    asc = make_autoscaler(router, spare_engines[1:], idle_evals=2,
+                          cooldown_s=0.0)
+    p = _prompts(4, sizes=(4,))[0]
+    h = router.submit(p, max_new_tokens=3)
+    router.run()
+    assert h.state == RouterRequestState.FINISHED
+    t = 2000.0
+    for i in range(8):
+        if asc.step(now=t + i) == "down":
+            break
+        router.step()
+    assert asc.scale_downs == 1
+    router.step()                            # retire sweep detaches the idle
+    assert len(router.replicas) == 1
+    assert router.retired                    # detached id recorded
+    # the survivor still serves
+    h2 = router.submit(p, max_new_tokens=3)
+    router.run()
+    assert h2.state == RouterRequestState.FINISHED
+    assert router.snapshot()["lost"] == 0
+
+
+def test_scale_down_migrates_inflight_bit_exact(base_engine, spare_engines):
+    """The drain-parity contract on scale-down: a BUSY replica retired with a
+    zero grace window evicts its in-flight requests WITH prefixes; they
+    complete on the survivor bit-identically to an uninterrupted run."""
+    router = make_router([base_engine, spare_engines[0]])
+    p0, p1, _, _ = _prompts(5)
+    h0 = router.submit(p0, max_new_tokens=20)
+    h1 = router.submit(p1, max_new_tokens=20)
+    for _ in range(50):
+        router.step()
+        if min(h0.result().size, h1.result().size) >= 4:
+            break
+    assert min(h0.result().size, h1.result().size) >= 4
+    victim = h0.replica_id
+    router.begin_retire(victim, grace_s=0.0)
+    assert router.replica_state(victim) == ReplicaState.RETIRING
+    router.run()
+    assert h0.state == h1.state == RouterRequestState.FINISHED
+    migrated = h0 if h0.retried else h1
+    assert migrated.retried >= 1 and migrated.evictions >= 1
+    np.testing.assert_array_equal(h0.result(), _ref(base_engine, p0, 20))
+    np.testing.assert_array_equal(h1.result(), _ref(base_engine, p1, 20))
+    assert victim in router.retired
+    assert all(r.id != victim for r in router.replicas)
+    snap = router.snapshot()
+    assert snap["lost"] == 0 and snap["evicted"] >= 1
+
+
+def test_cannot_retire_last_replica(base_engine):
+    router = make_router([base_engine])
+    with pytest.raises(ValueError, match="last serving replica"):
+        router.begin_retire(0)
+
+
+def test_kill_during_scale_down_drain(base_engine, spare_engines):
+    """Chaos ``kill:replica=i,when=draining``: the replica dies mid-retire;
+    its in-flight requests still migrate with prefixes (lost == 0, bit-exact
+    continuation) and the corpse is detached."""
+    router = make_router([base_engine, spare_engines[0]])
+    p0, p1, _, _ = _prompts(6)
+    h0 = router.submit(p0, max_new_tokens=20)
+    h1 = router.submit(p1, max_new_tokens=20)
+    for _ in range(50):
+        router.step()
+        if min(h0.result().size, h1.result().size) >= 3:
+            break
+    victim = h0.replica_id
+    chaos = ChaosSchedule([ChaosEvent(kind="kill", replica=victim,
+                                      when="draining")])
+    chaos.poll(router)                        # not retiring yet: no fire
+    assert not chaos.exhausted
+    router.begin_retire(victim, grace_s=30.0)  # long grace: the kill, not
+    chaos.poll(router)                         # the grace bound, must migrate
+    assert chaos.exhausted
+    router.replica_by_id(victim).last_heartbeat -= 1.0   # flatline
+    for _ in range(400):
+        router.step()
+        if h0.done and h1.done:
+            break
+    assert h0.state == h1.state == RouterRequestState.FINISHED
+    np.testing.assert_array_equal(h0.result(), _ref(base_engine, p0, 20))
+    np.testing.assert_array_equal(h1.result(), _ref(base_engine, p1, 20))
+    assert victim in router.retired
+    snap = router.snapshot()
+    assert snap["lost"] == 0
+
+
+# -------------------------------------------------- SLO admission + ladder
+def _warm_estimator(router, n=4, ttft=0.05, tpot=0.01):
+    for i in range(n):
+        router.estimator.observe(ttft_s=ttft, tpot_s=tpot, generated=8,
+                                 budget=8, now=time.monotonic() - (n - i) * 0.1)
+
+
+def test_slo_admission_sheds_infeasible(base_engine):
+    router = make_router([base_engine], slo_admission=True)
+    _warm_estimator(router)                   # est(8 tokens) ~ 0.13s
+    p = _prompts(7, sizes=(5,))[0]
+    with pytest.raises(AdmissionShedError) as ei:
+        router.submit(p, max_new_tokens=8, deadline_s=0.01)
+    assert ei.value.retry_after > 0           # load-adaptive hint rides along
+    assert ei.value.estimate_s > 0.01
+    assert router.telemetry.shed == 1
+    # shed is also backpressure-compatible: clients catching QueueFullError
+    # keep working unmodified
+    assert isinstance(ei.value, QueueFullError)
+    # a feasible deadline is admitted and completes inside it
+    h = router.submit(p, max_new_tokens=8, deadline_s=30.0)
+    router.run()
+    assert h.state == RouterRequestState.FINISHED
+    snap = router.snapshot()
+    assert snap["shed"] == 1 and snap["deadline_missed"] == 0
+
+
+def test_post_admission_expiry_counts_deadline_miss(base_engine):
+    """Without SLO admission a doomed request is admitted and expires late —
+    the accounting the shed path exists to zero out."""
+    router = make_router([base_engine], slo_admission=False)
+    p = _prompts(8, sizes=(5,))[0]
+    h = router.submit(p, max_new_tokens=8, deadline_s=0.001)
+    time.sleep(0.005)
+    router.run()
+    assert h.state == RouterRequestState.EXPIRED
+    snap = router.snapshot()
+    assert snap["deadline_missed"] == 1 and snap["expired"] == 1
+    assert snap["lost"] == 0                  # expiry is accounted, not lost
+
+
+def test_degradation_ladder_rungs(base_engine):
+    router = make_router([base_engine], max_queue=10, defer_fill=0.3,
+                         shed_fill=0.6, close_fill=0.9, slo_admission=True)
+    _warm_estimator(router, ttft=0.05, tpot=0.01)
+    p = _prompts(9, sizes=(4,))[0]
+    for _ in range(3):                        # fill 0.3 -> DEFER_LOW
+        router.submit(p, max_new_tokens=4)
+    assert router.degradation_rung == DegradationRung.HEALTHY
+    with pytest.raises(AdmissionDeferredError):
+        router.submit(p, max_new_tokens=4, priority=-1)
+    assert router.degradation_rung == DegradationRung.DEFER_LOW
+    assert router.telemetry.deferred == 1
+    h_norm = router.submit(p, max_new_tokens=4)    # normal priority admits
+    assert h_norm.state == RouterRequestState.QUEUED
+    for _ in range(2):                        # fill 0.6 -> SHED_INFEASIBLE
+        router.submit(p, max_new_tokens=4)
+    # at the shed rung the margin tightens: a deadline that would pass the
+    # plain estimate ( ~0.11s for 4 tokens + queue) is shed at margin 0.8
+    est = router.estimator.estimate_s(4, router.queue_depth)
+    with pytest.raises(AdmissionShedError):
+        router.submit(p, max_new_tokens=4, deadline_s=est * 0.9)
+    assert router.degradation_rung == DegradationRung.SHED_INFEASIBLE
+    for _ in range(3):                        # fill 0.9 -> ADMISSION_CLOSED
+        router.submit(p, max_new_tokens=4)
+    with pytest.raises(QueueFullError):
+        router.submit(p, max_new_tokens=4)    # closed before max_queue
+    assert router.degradation_rung == DegradationRung.ADMISSION_CLOSED
+    assert router.telemetry.rejected == 1
+    router.run()
+    assert router.snapshot()["lost"] == 0
+    assert router.degradation_rung == DegradationRung.HEALTHY
+
+
+def test_serve_stdin_shed_is_terminal_not_convoy(base_engine):
+    """A shed line gets an {"error": ...} response with the retry-after hint
+    and serving continues — resubmitting a deadline that re-anchors at every
+    attempt but sits below bare service time would re-shed forever and
+    head-of-line-block every later request."""
+    import io
+
+    from deepspeed_tpu.inference.serving import server as srv
+    router = make_router([base_engine], slo_admission=True)
+    _warm_estimator(router)
+    doomed = json.dumps({"prompt": [3, 4, 5], "max_new_tokens": 8,
+                         "deadline_s": 0.001})
+    fine = json.dumps({"prompt": [6, 7, 8], "max_new_tokens": 4})
+    out = io.StringIO()
+    srv._serve_stdin(router, out=out, inp=io.StringIO(doomed + "\n"
+                                                      + fine + "\n"))
+    lines = [json.loads(x) for x in out.getvalue().strip().splitlines()]
+    errs = [ln for ln in lines if "error" in ln]
+    done = [ln for ln in lines if ln.get("state") == "finished"]
+    assert len(errs) == 1 and "shed" in errs[0]["error"]
+    assert errs[0]["retry_after"] > 0
+    assert len(done) == 1                     # the feasible line still served
+    assert router.telemetry.shed == 1
+
+
+def test_idle_retire_sweep_detaches_without_traffic(base_engine,
+                                                    spare_engines):
+    """begin_retire on an IDLE router must complete via retiring_pending —
+    scale-downs happen exactly when there is no traffic to make it busy."""
+    router = make_router([base_engine, spare_engines[0]])
+    router.begin_retire(1)
+    assert not router.busy and router.retiring_pending
+    for _ in range(3):
+        if not router.retiring_pending:
+            break
+        router.step()
+    assert not router.retiring_pending
+    assert len(router.replicas) == 1 and 1 in router.retired
+
+
+# ------------------------------------------------- adaptive retry_after
+def test_retry_after_hint_scales_with_backlog(base_engine):
+    router = make_router([base_engine], retry_after_s=0.05,
+                         retry_after_max_s=4.0)
+    # no drain evidence: fill-scaled multiple of the floor
+    h0 = router.retry_after_hint()
+    assert h0 == pytest.approx(0.05)
+    p = _prompts(10, sizes=(4,))[0]
+    for _ in range(8):
+        router.submit(p, max_new_tokens=2)
+    assert router.retry_after_hint() > h0
+    # observed drain rate: hint ~ (depth+1)/rate, bounded by the cap
+    now = time.monotonic()
+    for i in range(5):
+        router.estimator._finishes.append(now - 1.0 + i * 0.25)  # 4/s drain
+    hint = router.retry_after_hint(now)
+    assert hint == pytest.approx((8 + 1) / 4.0, rel=0.05)
+    router.estimator._finishes.clear()
+    for i in range(40):                       # very fast drain -> floor
+        router.estimator._finishes.append(now - 0.1 + i * 0.0025)
+    assert router.retry_after_hint(now) == pytest.approx(0.05)
+    router.run()
+
+    # scheduler-side hint obeys the same contract
+    sched = ContinuousBatchingScheduler(base_engine, ServingConfig(
+        slots=2, chunk_size=3, max_seq_len=CAP, retry_after_s=0.1,
+        retry_after_max_s=2.0))
+    assert sched.retry_after_hint() == pytest.approx(0.1)
+    sched.telemetry._finish_times.extend(
+        time.monotonic() - 1.0 + i * 0.5 for i in range(3))   # 2/s drain
+    for _ in range(6):
+        sched.submit(p, max_new_tokens=2)
+    assert sched.retry_after_hint() > 0.1
+    sched.run()
+
+
+def test_adaptive_backoff_beats_static_convoy(base_engine):
+    """Satellite acceptance: under a surge against a tiny queue, clients
+    honouring the load-adaptive hint resubmit far less than clients convoying
+    on a static floor hint — same workload, same jitter rule."""
+    rng = np.random.default_rng(11)
+    p = _prompts(12, sizes=(4,))[0]
+
+    def drive(router):
+        pending = [[0.0, i] for i in range(10)]
+        handles, resubmits = {}, 0
+        t0 = time.monotonic()
+        while pending or router.busy:
+            now = time.monotonic()
+            for entry in [e for e in pending if e[0] <= now]:
+                try:
+                    handles[entry[1]] = router.submit(p, max_new_tokens=6)
+                    pending.remove(entry)
+                except QueueFullError as e:
+                    resubmits += 1
+                    entry[0] = now + e.retry_after * (0.5 + rng.random())
+            router.step()
+            if time.monotonic() - t0 > 30:
+                raise AssertionError("convoy drive did not finish")
+        assert all(h.done for h in handles.values())
+        return resubmits
+
+    # static: cap == floor pins the hint to 5ms however deep the backlog
+    static = drive(make_router([base_engine], max_queue=2,
+                               retry_after_s=0.005, retry_after_max_s=0.005))
+    adaptive = drive(make_router([base_engine], max_queue=2,
+                                 retry_after_s=0.005, retry_after_max_s=8.0))
+    assert adaptive < static, (adaptive, static)
+
+
+# ------------------------------------------------------------------- chaos
+def test_chaos_grammar_scale_events():
+    evs = parse_chaos("kill:replica=1,when=draining;surge:mult=4,at=0.5,s=2")
+    assert evs[0].when == "draining" and evs[1].kind == "surge"
+    with pytest.raises(ValueError, match="kill-only"):
+        parse_chaos("stall:replica=0,when=draining")
+    with pytest.raises(ValueError, match="at="):
+        parse_chaos("surge:mult=4")
+    with pytest.raises(ValueError, match="time-triggered"):
+        parse_chaos("surge:mult=4,at=1,when=busy")
+    with pytest.raises(ValueError, match="> 0"):
+        parse_chaos("surge:mult=0,at=1")
+
+
+def test_surge_load_multiplier():
+    sched = ChaosSchedule(parse_chaos("surge:mult=4,at=1,s=2;"
+                                      "surge:mult=2,at=2,s=2"), t0=100.0)
+    assert sched.load_multiplier(now=100.5) == pytest.approx(1.0)
+    assert sched.load_multiplier(now=101.5) == pytest.approx(4.0)
+    assert sched.load_multiplier(now=102.5) == pytest.approx(8.0)   # overlap
+    assert sched.load_multiplier(now=103.5) == pytest.approx(2.0)
+    assert sched.load_multiplier(now=104.5) == pytest.approx(1.0)
+
+
+# ----------------------------------------------------------------- loadgen
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "serving_loadgen_autoscale",
+        os.path.join(REPO, "benchmarks", "serving", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_schedule_smoke(capsys):
+    """Satellite: piecewise-Poisson schedule arrivals + per-window TTFT/TPOT
+    percentiles + replica-seconds in the BENCH JSON."""
+    loadgen = _load_loadgen()
+    rc = loadgen.main(["--smoke", "--arrival", "schedule:4@1,20@1,4@1",
+                       "--requests", "10"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    d = out["detail"]
+    assert d["all_finished"] and d["lost"] == 0
+    assert d["replica_seconds"] > 0
+    wins = d["windows"]
+    assert [w["rate"] for w in wins] == [4.0, 20.0, 4.0]
+    assert sum(w["requests"] for w in wins) == d["submitted"]
+    done_wins = [w for w in wins if w["completed"]]
+    assert done_wins
+    for w in done_wins:
+        assert w["ttft_ms_p50"] is not None
+        assert w["ttft_e2e_ms_p95"] is not None
+
+
+def test_loadgen_schedule_parse_errors():
+    loadgen = _load_loadgen()
+    with pytest.raises(ValueError, match="rate@duration"):
+        loadgen.parse_schedule("4,20@1")
+    with pytest.raises(ValueError, match="positive"):
+        loadgen.parse_schedule("0@1")
+    with pytest.raises(ValueError, match="empty"):
+        loadgen.parse_schedule("  ")
+    assert loadgen.parse_schedule("2@3,10@2") == [(2.0, 3.0), (10.0, 2.0)]
+
+
+def test_loadgen_autoscale_smoke(capsys):
+    """End-to-end control loop under a load swing: scales up AND back down,
+    lost == 0, every migrated request bit-exact, autoscale report present."""
+    loadgen = _load_loadgen()
+    rc = loadgen.main(["--smoke", "--autoscale", "--min-replicas", "1",
+                       "--max-replicas", "3",
+                       "--arrival", "schedule:3@1,40@1.5,3@2"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    d = out["detail"]
+    assert d["all_finished"] and d["lost"] == 0
+    assert d.get("parity_ok", True)
+    a = d["autoscale"]
+    assert a["scale_ups"] >= 1 and a["scale_downs"] >= 1
+    assert a["replica_seconds"] > 0
+    assert d["replicas"] == 1                 # settled back at min
+    assert d["retired_replicas"]
+    assert a["estimator"]["observations"] >= 1
