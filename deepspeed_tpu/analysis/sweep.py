@@ -67,7 +67,10 @@ def serving_lane(report: Report) -> None:
         dtype="float32", max_out_tokens=_CAP,
         weight_quant={"enabled": True, "bits": 8}))
 
-    ex = ChunkedDecodeExecutor(engine, slots=2, cap=_CAP, chunk_size=3)
+    # the legacy slot-row pool's movers, explicitly — the paged default's
+    # contracts live in paged_lane
+    ex = ChunkedDecodeExecutor(engine, slots=2, cap=_CAP, chunk_size=3,
+                               kv_pool="slots")
     lint = CompileCacheLint(engine._fns, target="serving-engine")
     rng = np.random.default_rng(0)
 
@@ -156,6 +159,83 @@ def serving_lane(report: Report) -> None:
 
     # host-sync runtime guard: the traced chunk body performs zero transfers
     report.add(trace_sync_findings(chunk, cargs, target="decode_chunk"))
+    set_global_mesh(None)
+
+
+# ---------------------------------------------------------------- paged lane
+def paged_lane(report: Report) -> None:
+    """Paged-KV serving contracts: donation on the page-table chunk /
+    suffix-prefill / scatter movers, and the one-compile-per-(slots, pages,
+    page, chunk, sampling)-key property across a MIXED-LENGTH workload —
+    page-count growth must ride the page table (runtime data), never mint a
+    new compile key."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..inference.config import DeepSpeedInferenceConfig
+    from ..inference.engine import InferenceEngine
+    from ..inference.serving.executor import ChunkedDecodeExecutor
+    from ..models.causal_lm import gpt2_cfg, init_cache
+    from ..parallel.mesh import set_global_mesh
+    from .donation import donation_findings
+    from .retrace import CompileCacheLint
+
+    cfg = gpt2_cfg(**_TINY, dtype=jnp.float32)
+    engine = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=_CAP))
+    ex = ChunkedDecodeExecutor(engine, slots=2, cap=_CAP, chunk_size=3,
+                               kv_pool="paged", kv_page_size=8)
+    lint = CompileCacheLint(engine._fns, target="paged-serving-engine")
+    rng = np.random.default_rng(0)
+
+    def one_request(plen, new):
+        prompt = rng.integers(0, _TINY["vocab_size"],
+                              size=plen).astype(np.int32)
+        slot = ex.pool.acquire(tokens=plen + new)
+        tok0, _ = ex.prefill_into_slot(slot, prompt, seed=0)
+        S = ex.slots
+        active = np.zeros(S, bool)
+        active[slot] = True
+        lens = np.full((S,), plen, np.int32)
+        r = ex.run_chunk(np.full((S,), tok0, np.int32), lens, active,
+                         np.full((S,), new, np.int32),
+                         np.full((S,), -1, np.int32), np.zeros(S, np.int32),
+                         np.zeros(S, np.int32))
+        ex.run_chunk(r.toks[:, 0], r.lens, r.active, r.remaining,
+                     np.full((S,), -1, np.int32), np.zeros(S, np.int32),
+                     r.steps)
+        ex.pool.release(slot)
+
+    def workload():
+        one_request(8, 5)     # 2 pages
+        one_request(20, 8)    # 4 pages: page growth, same chunk key
+
+    workload()                # warmup: every key compiles exactly once
+    lint.snapshot()
+    workload()                # mixed lengths again: zero new compiles allowed
+    report.add(lint.findings())
+
+    chunk_key = next(k for k in engine._fns if k[0] == "serve_chunk_paged")
+    S, mp = ex.slots, ex.pool.max_pages
+    chunk_args = (engine.params, jnp.zeros((S, 1), jnp.int32), ex.pool.caches,
+                  jnp.zeros((S, mp), jnp.int32), jnp.zeros((S,), jnp.int32),
+                  jnp.zeros((S,), bool), jnp.zeros((S,), jnp.int32),
+                  jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+                  jnp.zeros((S,), jnp.int32), ex._base_key)
+    report.add(donation_findings(engine._fns[chunk_key], chunk_args,
+                                 target="serve_chunk_paged"))
+    one = init_cache(cfg, 1, _CAP, dtype=engine.dtype)
+    report.add(donation_findings(ex.pool._scatter_fn,
+                                 (ex.pool.caches, one,
+                                  jnp.zeros((mp,), jnp.int32)),
+                                 target="paged_pool.scatter"))
+    sfn = ex._suffix_prefill_fn_paged(8)
+    sargs = (engine.params, ex.pool.caches, jnp.zeros((mp,), jnp.int32),
+             jnp.zeros((1, 8), jnp.int32), jnp.asarray([4], jnp.int32),
+             jnp.asarray([4], jnp.int32), jnp.asarray([0], jnp.int32),
+             ex._base_key)
+    report.add(donation_findings(sfn, sargs,
+                                 target="serve_suffix_prefill_paged"))
     set_global_mesh(None)
 
 
@@ -312,7 +392,7 @@ def run_sweep(repo_root: str, *, ast_only: bool = False,
     report = Report()
     ast_lane(report, repo_root, paths=paths)
     if not ast_only:
-        for lane in (serving_lane, train_lane, overlap_lane):
+        for lane in (serving_lane, paged_lane, train_lane, overlap_lane):
             try:
                 lane(report)
             except Exception as e:  # a crashed lane is a failed sweep

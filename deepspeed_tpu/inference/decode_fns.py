@@ -231,3 +231,122 @@ def build_decode_chunk(module, dequant, slot_select, chunk_size: int,
         return buf, toks, caches, lens, active, remaining, steps
 
     return decode_chunk
+
+
+def build_paged_decode_chunk(module, dequant, slot_select, chunk_size: int,
+                             kv_cap: int, overlap=None, fused: bool = False):
+    """Paged sibling of :func:`build_decode_chunk`: the caches are GLOBAL KV
+    pages (``{"k": (P, hk, page, d), ...}`` per layer) and each step writes at
+    the page-mapped row of the slot's static-shape ``page_table`` row — the
+    table itself never changes inside a chunk (pages are bound at admission),
+    so it rides as a loop constant. Every shape is static in (slots,
+    total-pages, page, chunk): a slot's page COUNT is runtime data in the
+    table, so page growth across requests never mints a compile key (pinned by
+    the analysis sweep's paged lane).
+
+    ``fused=True`` (TPU / ``DS_TPU_PAGED_FORCE_FUSED=1``): each step attends
+    straight against the pages through the Pallas gather-by-page-index kernel
+    — the dense view never materialises.
+
+    ``fused=False`` (the XLA fallback): the dense per-slot view is gathered
+    ONCE per chunk — hoisted out of the ``fori_loop``, same loop-invariance
+    idea as the dequant hoist — and carried through the steps; each step runs
+    the EXACT slot-row decode math on the carry (greedy bit-identity with the
+    slot pool is then structural, not analytical) and mirrors its appended
+    K/V row into the pages so they stay the source of truth across chunks. A
+    per-step gather cost S·cap bytes every step and measurably ate the paged
+    occupancy win on CPU hosts; per-chunk it is 1/K of that. ``kv_cap``
+    bounds the dense view at exactly the slot-row pool's ``cap``."""
+    from ..ops.paged_attention import gather_kv_dense
+
+    def decode_chunk(params, toks, caches, page_table, lens, active, remaining,
+                     eos_ids, seeds, steps, base_key):
+        # same dequant loop-invariance contract as build_decode_chunk
+        params = dequant(params)
+        S = toks.shape[0]
+        buf = jnp.zeros((S, chunk_size), jnp.int32)
+
+        if fused:
+            def body(i, s):
+                toks, caches, lens, active, remaining, steps, buf = s
+                logits, caches = module.apply(
+                    {"params": params}, toks, positions=lens[:, None],
+                    caches=caches, cache_lens=lens, page_table=page_table,
+                    kv_cap=kv_cap)
+                nxt = slot_select(logits[:, -1], base_key, seeds, steps)
+                tok = jnp.where(active[:, None], nxt,
+                                jnp.maximum(eos_ids, 0)[:, None]
+                                ).astype(jnp.int32)
+                buf = buf.at[:, i].set(tok[:, 0])
+                remaining = remaining - active.astype(jnp.int32)
+                finished = jnp.logical_or(tok[:, 0] == eos_ids, remaining <= 0)
+                lens = lens + active.astype(jnp.int32)
+                steps = steps + active.astype(jnp.int32)
+                active = jnp.logical_and(active, jnp.logical_not(finished))
+                return tok, caches, lens, active, remaining, steps, buf
+
+            with overlap_scope(overlap):
+                toks, caches, lens, active, remaining, steps, buf = \
+                    jax.lax.fori_loop(0, chunk_size, body,
+                                      (toks, caches, lens, active, remaining,
+                                       steps, buf))
+            return buf, toks, caches, lens, active, remaining, steps
+
+        # XLA fallback: hoisted per-chunk gather, pure slot-row steps over the
+        # dense carry, ONE end-of-chunk mirror of the appended rows back into
+        # the pages — the pages leave/enter the loop nowhere, so the loop body
+        # is byte-for-byte the slot pool's
+        ps = caches[0]["k"].shape[2]
+        mp = page_table.shape[1]
+        P_total = caches[0]["k"].shape[0]
+        lens_in = lens
+        dense = [dict(zip(("k", "v"),
+                          gather_kv_dense(c["k"], c["v"], page_table, kv_cap)))
+                 for c in caches]
+
+        def body(i, s):
+            toks, dense, lens, active, remaining, steps, buf = s
+            logits, dense = module.apply(
+                {"params": params}, toks, positions=lens[:, None],
+                caches=dense, cache_lens=lens)
+            nxt = slot_select(logits[:, -1], base_key, seeds, steps)
+            tok = jnp.where(active[:, None], nxt,
+                            jnp.maximum(eos_ids, 0)[:, None]).astype(jnp.int32)
+            buf = buf.at[:, i].set(tok[:, 0])
+            remaining = remaining - active.astype(jnp.int32)
+            finished = jnp.logical_or(tok[:, 0] == eos_ids, remaining <= 0)
+            lens = lens + active.astype(jnp.int32)
+            steps = steps + active.astype(jnp.int32)
+            active = jnp.logical_and(active, jnp.logical_not(finished))
+            return tok, dense, lens, active, remaining, steps, buf
+
+        with overlap_scope(overlap):     # trace-time: fori body traces inside
+            toks, dense, lens, active, remaining, steps, buf = \
+                jax.lax.fori_loop(0, chunk_size, body,
+                                  (toks, dense, lens, active, remaining,
+                                   steps, buf))
+        # mirror rows [lens_in, lens) (this chunk's appends) into the pages;
+        # rows a slot never advanced past, or beyond cap, route to an
+        # out-of-range page index and the scatter drops them
+        done = lens - lens_in
+        new_caches = []
+        for c, dn in zip(caches, dense):
+            k_p, v_p = c["k"], c["v"]
+            for j in range(chunk_size):
+                rows = lens_in + j
+                page_pos = jnp.clip(rows // ps, 0, mp - 1)
+                pidx = jnp.where((j < done) & (rows < kv_cap),
+                                 jnp.take_along_axis(
+                                     page_table, page_pos[:, None],
+                                     axis=1)[:, 0],
+                                 P_total)
+                off = rows % ps
+                idx = jnp.minimum(rows, kv_cap - 1)[:, None, None, None]
+                k_new = jnp.take_along_axis(dn["k"], idx, axis=2)[:, :, 0, :]
+                v_new = jnp.take_along_axis(dn["v"], idx, axis=2)[:, :, 0, :]
+                k_p = k_p.at[pidx, :, off, :].set(k_new.astype(k_p.dtype))
+                v_p = v_p.at[pidx, :, off, :].set(v_new.astype(v_p.dtype))
+            new_caches.append({"k": k_p, "v": v_p})
+        return buf, toks, new_caches, lens, active, remaining, steps
+
+    return decode_chunk

@@ -3,9 +3,11 @@ behind a health-supervised multi-replica router.
 
 Layers (bottom-up):
 
-- :mod:`kv_pool` — :class:`SlotKVPool`: slot-indexed fixed-capacity KV buffers
-  built on ``init_cache``; scatter-in prefill, zero-fill on release, donated
-  updates throughout;
+- :mod:`kv_pool` — :class:`PagedKVPool` (default): one global pool of
+  fixed-size KV pages behind static-shape per-slot page tables — page-count
+  admission, refcounted zero-copy prefix sharing (copy-on-write boundary
+  page), donated movers; :class:`SlotKVPool`: the legacy slot-indexed
+  fixed-capacity buffers (scatter-in prefill, zero-fill on release);
 - :mod:`executor` — :class:`ChunkedDecodeExecutor`: compiled fixed-shape decode
   chunks of K steps over the slot-batch (one compile per (slots, cap, chunk,
   sampling) key), per-slot prefill bucketed by prompt length, optional per-chunk
@@ -39,7 +41,7 @@ from .autoscale import (Autoscaler, AutoscaleConfig, EstimatorConfig,
                         ServiceTimeEstimator)
 from .chaos import ChaosEvent, ChaosSchedule, parse_chaos
 from .executor import ChunkedDecodeExecutor, ChunkTimeoutError
-from .kv_pool import SlotKVPool
+from .kv_pool import PagedKVPool, SlotKVPool
 from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .router import (AdmissionDeferredError, AdmissionShedError,
                      DegradationRung, EngineReplica, ReplicaDeadError,
@@ -50,7 +52,7 @@ from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
 from .telemetry import ServingTelemetry
 
 __all__ = [
-    "ChunkedDecodeExecutor", "ChunkTimeoutError", "SlotKVPool",
+    "ChunkedDecodeExecutor", "ChunkTimeoutError", "SlotKVPool", "PagedKVPool",
     "PrefixCache", "PrefixCacheConfig",
     "ContinuousBatchingScheduler", "QueueFullError", "RequestHandle",
     "RequestState", "ServingConfig", "ServingTelemetry",

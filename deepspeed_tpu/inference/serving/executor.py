@@ -40,9 +40,10 @@ from ...observability import profiler as obs_profiler
 from ...observability.trace import get_tracer
 from ...utils.fault_injection import fault_point
 from ...utils.nvtx import annotate
-from ..decode_fns import (build_decode_chunk, build_prefill,
-                          build_prefix_prefill, make_slot_select_fn)
-from .kv_pool import SlotKVPool
+from ..decode_fns import (build_decode_chunk, build_paged_decode_chunk,
+                          build_prefill, build_prefix_prefill,
+                          make_slot_select_fn)
+from .kv_pool import PagedKVPool, SlotKVPool
 
 
 class ChunkTimeoutError(RuntimeError):
@@ -88,12 +89,17 @@ class ChunkedDecodeExecutor:
                  top_k: int = 0, top_p: float = 1.0, max_prompt_len: Optional[int]
                  = None, base_seed: int = 0,
                  chunk_deadline_s: Optional[float] = None,
-                 cold_chunk_grace_s: float = 120.0):
+                 cold_chunk_grace_s: float = 120.0,
+                 kv_pool: str = "paged", kv_page_size: int = 16,
+                 kv_total_pages: Optional[int] = None):
         if chunk_size < 1:
             raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
         if chunk_deadline_s is not None and chunk_deadline_s <= 0:
             raise ValueError("chunk_deadline_s must be positive when set, got "
                              f"{chunk_deadline_s}")
+        if kv_pool not in ("paged", "slots"):
+            raise ValueError(f"kv_pool must be 'paged' or 'slots', "
+                             f"got {kv_pool!r}")
         self.engine = engine
         self.slots = int(slots)
         self.cap = int(cap)
@@ -105,8 +111,10 @@ class ChunkedDecodeExecutor:
         self.sampling = (bool(do_sample), float(temperature), int(top_k),
                          float(top_p))
         self.buckets = prompt_buckets(self.max_prompt_len)
-        self.pool = SlotKVPool(engine.model_config, self.slots, self.cap,
-                               dtype=engine.dtype)
+        self.kv_pool_kind = kv_pool
+        self.kv_page_size = int(kv_page_size)
+        self.kv_total_pages = kv_total_pages
+        self.pool = self._build_pool()
         self._slot_select = make_slot_select_fn(*self.sampling)
         self._base_key = jax.random.PRNGKey(base_seed)
         self.chunk_deadline_s = chunk_deadline_s
@@ -144,22 +152,66 @@ class ChunkedDecodeExecutor:
         mode the watchdog exists to remove."""
         self._stall_next = float(seconds)
 
+    def _build_pool(self):
+        if self.kv_pool_kind == "paged":
+            return PagedKVPool(self.engine.model_config, self.slots, self.cap,
+                               page_size=self.kv_page_size,
+                               dtype=self.engine.dtype,
+                               total_pages=self.kv_total_pages)
+        return SlotKVPool(self.engine.model_config, self.slots, self.cap,
+                          dtype=self.engine.dtype)
+
+    @property
+    def paged(self) -> bool:
+        return self.kv_pool_kind == "paged"
+
     def reset_pool(self) -> None:
         """Discard the pool (e.g. after a failed dispatch that may have consumed
-        donated buffers) and rebuild it fresh, every slot free."""
-        self.pool = SlotKVPool(self.engine.model_config, self.slots, self.cap,
-                               dtype=self.engine.dtype)
+        donated buffers) and rebuild it fresh, every slot free. On the paged
+        pool this also voids every page the prefix cache holds references to —
+        the scheduler clears its cache alongside (``_rebuild_pool``)."""
+        self.pool = self._build_pool()
 
     # ------------------------------------------------------------- compiled fns
     def _chunk_fn(self):
-        key = ("serve_chunk", self.slots, self.cap, self.chunk_size, self.sampling)
+        if self.paged:
+            from ...ops.paged_attention import fused_paged_for
+            from ...parallel.mesh import AXIS_TENSOR, get_global_mesh
+            mesh = get_global_mesh()
+            cfg = self.engine.model_config
+            # the fused kernel has no alibi bias (the layer would re-gather
+            # the dense view EVERY step inside the loop — the fallback hoists
+            # it once per chunk), no shard_map TP path (the fallback's dense
+            # steps route through _sharded_decode), and its dispatcher needs
+            # a lane-aligned head dim on-chip (fused_paged_for mirrors it);
+            # every excluded regime decodes strictly faster on the fallback
+            fused = fused_paged_for(cfg.head_dim) \
+                and getattr(cfg, "pos_emb", None) != "alibi" \
+                and (mesh is None or mesh.size(AXIS_TENSOR) <= 1)
+            # one compile per (slots, pages, page, cap, chunk, sampling) key:
+            # per-request page COUNTS are runtime table data, so mixed-length
+            # traffic and page growth never mint a new key (sweep-pinned).
+            # The fused flag is part of the key — tests toggle the env var.
+            key = ("serve_chunk_paged", self.slots, self.pool.total_pages,
+                   self.pool.page_size, self.cap, self.chunk_size,
+                   self.sampling, fused)
+        else:
+            key = ("serve_chunk", self.slots, self.cap, self.chunk_size,
+                   self.sampling)
         fns = self.engine._fns
         if key not in fns:
-            chunk = build_decode_chunk(self.engine.module, self.engine._dequant,
-                                       self._slot_select, self.chunk_size,
-                                       overlap=getattr(self.engine,
-                                                       "comm_overlap", None))
-            fns[key] = jax.jit(chunk, donate_argnums=(2,))   # caches
+            overlap = getattr(self.engine, "comm_overlap", None)
+            if self.paged:
+                chunk = build_paged_decode_chunk(
+                    self.engine.module, self.engine._dequant,
+                    self._slot_select, self.chunk_size, kv_cap=self.cap,
+                    overlap=overlap, fused=fused)
+            else:
+                chunk = build_decode_chunk(self.engine.module,
+                                           self.engine._dequant,
+                                           self._slot_select, self.chunk_size,
+                                           overlap=overlap)
+            fns[key] = jax.jit(chunk, donate_argnums=(2,))   # caches/pages
         return fns[key]
 
     def _prefill_fn(self, bucket: int):
@@ -218,6 +270,61 @@ class ChunkedDecodeExecutor:
             fns[key] = jax.jit(prefill, donate_argnums=(1,))
         return fns[key]
 
+    def _suffix_prefill_fn_paged(self, bucket: int):
+        """Paged cache-hit prefill: the slot's pages (shared prefix pages
+        bound zero-copy at admission + its COW/fresh pages) are gathered into
+        the dense batch-1 view INSIDE the dispatch, the suffix forward runs at
+        the prefix offset, and ONLY the suffix rows scatter back to their
+        page-mapped positions — shared pages are read, never written. The
+        POOL pages flow through and are donated; one compile per
+        (pages, page, cap, suffix-bucket, sampling) key."""
+        key = ("serve_suffix_prefill_paged", self.pool.total_pages,
+               self.pool.page_size, self.cap, bucket, self.sampling)
+        fns = self.engine._fns
+        if key not in fns:
+            engine = self.engine
+            prefix_prefill = build_prefix_prefill(
+                engine.module, engine._dequant,
+                overlap=getattr(engine, "comm_overlap", None))
+            select = self._slot_select
+            cap = self.cap
+            ps, mp = self.pool.page_size, self.pool.max_pages
+            P_total = self.pool.total_pages
+
+            def prefill(params, caches, tbl, ids, prefix_len, suffix_len,
+                        seed, base_key):
+                one = []
+                for c in caches:
+                    _, hk, _, d = c["k"].shape
+                    k = c["k"][tbl].transpose(1, 0, 2, 3).reshape(hk, -1, d)
+                    v = c["v"][tbl].transpose(1, 0, 2, 3).reshape(hk, -1, d)
+                    one.append({"k": k[None, :, :cap, :],
+                                "v": v[None, :, :cap, :]})
+                logits, new_one = prefix_prefill(params, ids, one, prefix_len,
+                                                 suffix_len)
+                tok0 = select(logits, base_key, seed, jnp.zeros_like(seed))
+                # scatter ONLY the suffix rows [prefix, prefix + bucket) back;
+                # rows beyond cap route to an out-of-range page index and the
+                # scatter drops them (the dense path's OOB-pad-drop contract)
+                t = ids.shape[1]
+                rows = prefix_len[0] + jnp.arange(t)
+                page_pos = jnp.clip(rows // ps, 0, mp - 1)
+                pidx = jnp.where(rows < cap, tbl[page_pos], P_total)
+                off = rows % ps
+                out = []
+                for c, n in zip(caches, new_one):
+                    kv = {}
+                    for key_ in ("k", "v"):
+                        vals = jnp.take(n[key_][0], rows, axis=1,
+                                        mode="clip").transpose(1, 0, 2)
+                        kv[key_] = c[key_].at[pidx, :, off, :].set(
+                            vals.astype(c[key_].dtype))
+                    out.append(kv)
+                return tok0, out
+
+            fns[key] = jax.jit(prefill, donate_argnums=(1,))
+        return fns[key]
+
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.buckets:
             if prompt_len <= b:
@@ -256,29 +363,51 @@ class ChunkedDecodeExecutor:
             bucket = self.bucket_for(suffix.size)
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :suffix.size] = suffix
-            fn = self._suffix_prefill_fn(bucket)
             t0 = time.perf_counter()
             tr0 = time.monotonic()
-            with annotate("serving.restore_prefix"):
-                self.pool.restore_prefix(slot, prefix_slab)
-            tracer.record_span("restore_prefix", trace_ctx, tr0,
-                               time.monotonic(),
-                               attrs={"slot": slot,
-                                      "prefix_len": int(prefix_len)})
+            if self.paged:
+                # zero-copy hit: the prefix pages were BOUND into the slot's
+                # table at admission (refcount bump + one COW page) — there is
+                # no slab restore to pay; the span records the bind seam
+                fn = self._suffix_prefill_fn_paged(bucket)
+                tracer.record_span("bind_prefix", trace_ctx, tr0,
+                                   time.monotonic(),
+                                   attrs={"slot": slot,
+                                          "prefix_len": int(prefix_len)})
+            else:
+                fn = self._suffix_prefill_fn(bucket)
+                with annotate("serving.restore_prefix"):
+                    self.pool.restore_prefix(slot, prefix_slab)
+                tracer.record_span("restore_prefix", trace_ctx, tr0,
+                                   time.monotonic(),
+                                   attrs={"slot": slot,
+                                          "prefix_len": int(prefix_len)})
+            # the restore->prefill (paged: bind->prefill) seam: the chaos
+            # when=restore hook and fault point fire exactly here, after the
+            # pool/table was touched and before the suffix forward
             fault_point("serving.prefix_restore")
             if self._restore_kill is not None:
                 cb, self._restore_kill = self._restore_kill, None
                 cb()
                 raise RuntimeError("chaos: replica killed between prefix "
-                                   "restore and suffix prefill")
+                                   "restore/bind and suffix prefill")
             ts0 = time.monotonic()
             with annotate("serving.suffix_prefill"):
-                tok0, caches = fn(self.engine.params, self.pool.caches,
-                                  np.int32(slot), jnp.asarray(ids),
-                                  jnp.asarray([prefix_len], jnp.int32),
-                                  jnp.asarray([suffix.size], jnp.int32),
-                                  jnp.asarray([seed], jnp.int32),
-                                  self._base_key)
+                if self.paged:
+                    tok0, caches = fn(self.engine.params, self.pool.caches,
+                                      jnp.asarray(self.pool.page_table[slot]),
+                                      jnp.asarray(ids),
+                                      jnp.asarray([prefix_len], jnp.int32),
+                                      jnp.asarray([suffix.size], jnp.int32),
+                                      jnp.asarray([seed], jnp.int32),
+                                      self._base_key)
+                else:
+                    tok0, caches = fn(self.engine.params, self.pool.caches,
+                                      np.int32(slot), jnp.asarray(ids),
+                                      jnp.asarray([prefix_len], jnp.int32),
+                                      jnp.asarray([suffix.size], jnp.int32),
+                                      jnp.asarray([seed], jnp.int32),
+                                      self._base_key)
                 self.pool.caches = caches
                 # lint: host-sync-ok (honest TTFT: first token synced on purpose)
                 tok0 = int(np.asarray(tok0)[0, 0])
@@ -325,12 +454,21 @@ class ChunkedDecodeExecutor:
         # wedged chunk and the caller rebuilds the pool, the late-finishing
         # thread must keep donating the OLD buffers, never the fresh pool's
         caches_in = self.pool.caches
-        args = (self.engine.params,
-                jnp.asarray(toks, jnp.int32).reshape(-1, 1), caches_in,
-                jnp.asarray(lens, jnp.int32), jnp.asarray(active, bool),
-                jnp.asarray(remaining, jnp.int32), jnp.asarray(eos_ids, jnp.int32),
-                jnp.asarray(seeds, jnp.int32), jnp.asarray(steps, jnp.int32),
-                self._base_key)
+        state = (jnp.asarray(lens, jnp.int32), jnp.asarray(active, bool),
+                 jnp.asarray(remaining, jnp.int32),
+                 jnp.asarray(eos_ids, jnp.int32),
+                 jnp.asarray(seeds, jnp.int32), jnp.asarray(steps, jnp.int32),
+                 self._base_key)
+        if self.paged:
+            # the table is host state bound at admission; it never changes
+            # inside a chunk, so it rides as a (tiny) per-dispatch operand
+            args = (self.engine.params,
+                    jnp.asarray(toks, jnp.int32).reshape(-1, 1), caches_in,
+                    jnp.asarray(self.pool.page_table)) + state
+        else:
+            args = (self.engine.params,
+                    jnp.asarray(toks, jnp.int32).reshape(-1, 1),
+                    caches_in) + state
         t0 = time.perf_counter()
 
         def timed():

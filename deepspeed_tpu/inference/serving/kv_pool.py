@@ -1,25 +1,102 @@
-"""Slot-indexed KV cache pool.
+"""KV cache pools: slot-rows (legacy) and the paged page-table pool.
 
-One fixed allocation of ``init_cache(cfg, slots, cap)`` per pool; requests borrow a
-slot (row) for their lifetime. Every pool mutation — scatter-in of a prefill's
-batch-1 cache, prefix-slab restore on a cache hit, zero-fill on release — runs as
-a donated jitted update, so the pool's HBM footprint is constant: jax 0.4.37
-honours ``donate_argnums`` on CPU too, so there are no backend guards (guarding
-donation behind backend checks cost 1500x on pool scatters in an earlier revision
-of this codebase). ``gather_prefix`` is the one non-donating copy-out: it hands
-the prefix cache (and, next, disaggregated prefill) slabs whose lifetime is
-independent of the pool's.
+:class:`SlotKVPool` — one fixed allocation of ``init_cache(cfg, slots, cap)``
+per pool; requests borrow a slot (row) for their lifetime, so every slot
+reserves its worst-case ``cap`` KV up front. Every pool mutation — scatter-in
+of a prefill's batch-1 cache, prefix-slab restore on a cache hit, zero-fill on
+release — runs as a donated jitted update, so the pool's HBM footprint is
+constant: jax 0.4.37 honours ``donate_argnums`` on CPU too, so there are no
+backend guards (guarding donation behind backend checks cost 1500x on pool
+scatters in an earlier revision of this codebase).
 
-Per-slot sequence lengths are scheduler state (host numpy, passed into each decode
-chunk); the pool owns only the device buffers and the free list.
+:class:`PagedKVPool` — the default since PR 13: one global pool of fixed-size
+KV **pages** per layer (``{"k": (P, hk, page, d), ...}``) behind a static-shape
+per-slot page table. A slot allocates only the pages its ``prompt + max_new``
+needs (page-granular admission: occupancy tracks requested tokens, not the
+pow2-bucketed worst case), pages are refcounted so the prefix cache can
+**share** a prompt's pages zero-copy (a hit binds page indices into the new
+slot's table — no slab gather, no restore scatter; the first partially-covered
+page is copy-on-write), and a page is the shipment unit disaggregated prefill
+will serialize. Released pages are NOT zero-filled: every row below a slot's
+``cache_len`` is freshly written (prefill/suffix/decode) or a verbatim shared
+prefix row, and attention masks everything at or beyond ``cache_len`` — the
+leak-safety argument the slot pool bought with a zero scatter is structural
+here, and release becomes O(pages) host bookkeeping.
+
+``gather_prefix``/``restore_prefix`` survive on BOTH pools as the dense-slab
+serialization API (page-granular underneath on the paged pool) — the wire
+format disaggregated prefill ships between replicas.
+
+Per-slot sequence lengths are scheduler state (host numpy, passed into each
+decode chunk); the pool owns the device buffers, the free lists and (paged)
+the page table + refcounts.
 """
 
+import functools
+import math
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ...models.causal_lm import init_cache
+
+
+# Slot-pool movers at MODULE level (shape-keyed jit singletons), same reason
+# as the paged movers below: a pool is rebuilt on every reset_pool (failure
+# recovery) and per serving lane, and per-instance jitted closures re-paid
+# their XLA compile each time.
+@functools.lru_cache(maxsize=None)
+def _slot_scatter_jit():
+    def scatter(caches, one, slot):
+        return [{"k": c["k"].at[slot].set(o["k"][0]),
+                 "v": c["v"].at[slot].set(o["v"][0])}
+                for c, o in zip(caches, one)]
+
+    return jax.jit(scatter, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _slot_zero_jit():
+    def zero_fill(caches, slot):
+        return [{"k": c["k"].at[slot].set(0.0),
+                 "v": c["v"].at[slot].set(0.0)} for c in caches]
+
+    return jax.jit(zero_fill, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _slot_gather_jit(R: int):
+    def gather(caches, slot):
+        out = []
+        for c in caches:
+            _, hk, _, d = c["k"].shape
+            out.append({
+                "k": jax.lax.dynamic_slice(
+                    c["k"], (slot, 0, 0, 0), (1, hk, R, d))[0],
+                "v": jax.lax.dynamic_slice(
+                    c["v"], (slot, 0, 0, 0), (1, hk, R, d))[0]})
+        return out
+
+    return jax.jit(gather)
+
+
+@functools.lru_cache(maxsize=None)
+def _slot_restore_jit():
+    def restore(caches, slab, slot):
+        out = []
+        for c, s in zip(caches, slab):
+            out.append({
+                "k": jax.lax.dynamic_update_slice(
+                    c["k"], s["k"][None].astype(c["k"].dtype),
+                    (slot, 0, 0, 0)),
+                "v": jax.lax.dynamic_update_slice(
+                    c["v"], s["v"][None].astype(c["v"].dtype),
+                    (slot, 0, 0, 0))})
+        return out
+
+    return jax.jit(restore, donate_argnums=(0,))
 
 
 class SlotKVPool:
@@ -32,29 +109,24 @@ class SlotKVPool:
         self.cap = int(cap)
         self.caches = init_cache(model_config, self.slots, self.cap, dtype=dtype)
         self._free: List[int] = list(range(self.slots))
-        # prefix-cache slab movers, one compile per padded row count R (row
-        # counts are power-of-two prompt buckets, so the key set is tiny)
-        self._gather_fns: Dict[int, Any] = {}
-        self._restore_fns: Dict[int, Any] = {}
-
-        def scatter(caches, one, slot):
-            return [{"k": c["k"].at[slot].set(o["k"][0]),
-                     "v": c["v"].at[slot].set(o["v"][0])}
-                    for c, o in zip(caches, one)]
-
-        def zero_fill(caches, slot):
-            return [{"k": c["k"].at[slot].set(0.0),
-                     "v": c["v"].at[slot].set(0.0)} for c in caches]
-
         # pool buffers donated unconditionally: the old ones are always dead after
         # the update (the prefill's batch-1 cache is NOT donatable — its (1, ...)
         # buffers cannot alias any (slots, ...) output)
-        self._scatter_fn = jax.jit(scatter, donate_argnums=(0,))
-        self._zero_fn = jax.jit(zero_fill, donate_argnums=(0,))
+        self._scatter_fn = _slot_scatter_jit()
+        self._zero_fn = _slot_zero_jit()
 
     # ------------------------------------------------------------ slot lifecycle
-    def acquire(self) -> Optional[int]:
-        """Borrow a free slot index, or ``None`` when the pool is full."""
+    def can_admit(self, tokens: Optional[int] = None, matched: int = 0) -> bool:
+        """Shared admission protocol with :class:`PagedKVPool` — here a slot
+        IS the reservation, so only slot availability matters."""
+        return bool(self._free)
+
+    def acquire(self, tokens: Optional[int] = None, prefix_pages=None,
+                matched: int = 0) -> Optional[int]:
+        """Borrow a free slot index, or ``None`` when the pool is full.
+        ``tokens``/``prefix_pages``/``matched`` are accepted for protocol
+        parity with :class:`PagedKVPool` and ignored (a slot reserves ``cap``
+        regardless)."""
         return self._free.pop(0) if self._free else None
 
     def release(self, slot: int) -> None:
@@ -79,20 +151,7 @@ class SlotKVPool:
         R = int(rows)
         if not 0 < R <= self.cap:
             raise ValueError(f"rows must be in [1, cap={self.cap}], got {R}")
-        fn = self._gather_fns.get(R)
-        if fn is None:
-            def gather(caches, slot):
-                out = []
-                for c in caches:
-                    _, hk, _, d = c["k"].shape
-                    out.append({
-                        "k": jax.lax.dynamic_slice(
-                            c["k"], (slot, 0, 0, 0), (1, hk, R, d))[0],
-                        "v": jax.lax.dynamic_slice(
-                            c["v"], (slot, 0, 0, 0), (1, hk, R, d))[0]})
-                return out
-            fn = self._gather_fns[R] = jax.jit(gather)
-        return fn(self.caches, np.int32(slot))
+        return _slot_gather_jit(R)(self.caches, np.int32(slot))
 
     def slab_nbytes(self, rows: int) -> int:
         """Host-side size of a ``rows``-row slab — lets callers apply byte
@@ -112,21 +171,7 @@ class SlotKVPool:
         R = int(slab[0]["k"].shape[1])
         if R > self.cap:
             raise ValueError(f"slab rows {R} exceed pool cap {self.cap}")
-        fn = self._restore_fns.get(R)
-        if fn is None:
-            def restore(caches, slab, slot):
-                out = []
-                for c, s in zip(caches, slab):
-                    out.append({
-                        "k": jax.lax.dynamic_update_slice(
-                            c["k"], s["k"][None].astype(c["k"].dtype),
-                            (slot, 0, 0, 0)),
-                        "v": jax.lax.dynamic_update_slice(
-                            c["v"], s["v"][None].astype(c["v"].dtype),
-                            (slot, 0, 0, 0))})
-                return out
-            fn = self._restore_fns[R] = jax.jit(restore, donate_argnums=(0,))
-        self.caches = fn(self.caches, slab, np.int32(slot))
+        self.caches = _slot_restore_jit()(self.caches, slab, np.int32(slot))
 
     # ------------------------------------------------------------------ metrics
     @property
@@ -136,3 +181,352 @@ class SlotKVPool:
     @property
     def occupancy(self) -> float:
         return 1.0 - len(self._free) / self.slots
+
+    @property
+    def paged(self) -> bool:
+        return False
+
+
+NULL_PAGE = 0      # reserved sentinel: pads every table row; rows it could
+#   contribute are always masked by cache_len, writes to it are dead stores
+
+
+# Paged movers live at MODULE level (lru_cache + jit-by-shape), not on the
+# pool instance: a pool is rebuilt on every reset_pool (failure recovery) and
+# per serving lane, and per-instance jitted closures re-paid their XLA compile
+# each time — measured at ~0.15 s per pool, which dominated short serving
+# runs. Geometry (page size, table width, layer count) is recovered from the
+# argument shapes, so one compiled mover serves every same-shaped pool.
+@functools.lru_cache(maxsize=None)
+def _paged_scatter_jit():
+    def scatter(caches, one, tbl):
+        # write a prefill's dense batch-1 cache into the slot's pages; rows
+        # beyond cap pad with zeros into the (dead) null page
+        mp = tbl.shape[0]
+        out = []
+        for c, o in zip(caches, one):
+            _, hk, cap_r, d = o["k"].shape
+            ps = c["k"].shape[2]
+            pad = ((0, 0), (0, mp * ps - cap_r), (0, 0))
+            k = jnp.pad(o["k"][0], pad).reshape(hk, mp, ps, d)
+            v = jnp.pad(o["v"][0], pad).reshape(hk, mp, ps, d)
+            out.append({
+                "k": c["k"].at[tbl].set(
+                    k.transpose(1, 0, 2, 3).astype(c["k"].dtype)),
+                "v": c["v"].at[tbl].set(
+                    v.transpose(1, 0, 2, 3).astype(c["v"].dtype))})
+        return out
+
+    return jax.jit(scatter, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_cow_jit():
+    def cow(caches, src, dst):
+        return [{"k": c["k"].at[dst].set(c["k"][src]),
+                 "v": c["v"].at[dst].set(c["v"][src])} for c in caches]
+
+    return jax.jit(cow, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_gather_jit(R: int):
+    def gather(caches, tbl):
+        out = []
+        for c in caches:
+            _, hk, ps, d = c["k"].shape
+            k = c["k"][tbl].transpose(1, 0, 2, 3).reshape(hk, -1, d)
+            v = c["v"][tbl].transpose(1, 0, 2, 3).reshape(hk, -1, d)
+            out.append({"k": k[:, :R, :], "v": v[:, :R, :]})
+        return out
+
+    return jax.jit(gather)
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_restore_jit(R: int):
+    def restore(caches, slab, tbl):
+        n = tbl.shape[0]
+        out = []
+        for c, s in zip(caches, slab):
+            hk, _, d = s["k"].shape
+            ps = c["k"].shape[2]
+            pad = ((0, 0), (0, n * ps - R), (0, 0))
+            k = jnp.pad(s["k"], pad).reshape(hk, n, ps, d)
+            v = jnp.pad(s["v"], pad).reshape(hk, n, ps, d)
+            out.append({
+                "k": c["k"].at[tbl].set(
+                    k.transpose(1, 0, 2, 3).astype(c["k"].dtype)),
+                "v": c["v"].at[tbl].set(
+                    v.transpose(1, 0, 2, 3).astype(c["v"].dtype))})
+        return out
+
+    return jax.jit(restore, donate_argnums=(0,))
+
+
+class PagedKVPool:
+    """Global fixed-size KV pages behind per-slot page tables (see module
+    docstring). ``cap`` is the per-slot row capacity the compiled fns see —
+    pages round it UP internally (``max_pages = ceil(cap / page)``) but every
+    dense view the model computes over is sliced back to exactly ``cap`` rows,
+    so attention math (reduction shapes included) is bit-identical to the
+    slot-row pool's."""
+
+    def __init__(self, model_config, slots: int, cap: int, page_size: int = 16,
+                 dtype=None, total_pages: Optional[int] = None):
+        if slots < 1 or cap < 2:
+            raise ValueError(f"need slots >= 1 and cap >= 2, got {slots}, {cap}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.slots = int(slots)
+        self.cap = int(cap)
+        self.page_size = ps = int(page_size)
+        self.max_pages = mp = math.ceil(self.cap / ps)   # table width per slot
+        if total_pages is None:
+            # default budget matches the slot-row pool's HBM exactly (plus the
+            # one null page): same bytes, page-granular occupancy
+            total_pages = self.slots * mp + 1
+        self.total_pages = P = int(total_pages)
+        if P < mp + 1:
+            raise ValueError(
+                f"total_pages={P} cannot hold even one max-size request "
+                f"({mp} pages) plus the null page")
+        cfg = model_config
+        self.n_layer = cfg.n_layer
+        dtype = dtype or cfg.dtype
+        shape = (P, cfg.kv_heads, ps, cfg.head_dim)
+        self.caches = [{"k": jnp.zeros(shape, dtype),
+                        "v": jnp.zeros(shape, dtype)}
+                       for _ in range(cfg.n_layer)]
+        self.page_nbytes = 2 * cfg.n_layer * cfg.kv_heads * ps * \
+            cfg.head_dim * jnp.dtype(dtype).itemsize
+        # host allocator state
+        self.page_table = np.full((self.slots, mp), NULL_PAGE, np.int32)
+        self._free_slots: List[int] = list(range(self.slots))
+        self._free_pages: List[int] = list(range(1, P))     # 0 = null page
+        self._ref = np.zeros(P, np.int64)
+        self._slot_npages = np.zeros(self.slots, np.int32)
+        self._slot_tokens = np.zeros(self.slots, np.int64)  # reserved tokens
+        self.cow_copies_total = 0
+        # pool pages donated unconditionally (same contract as SlotKVPool:
+        # the old buffers are always dead after the update); the jitted
+        # movers are module-level shape-keyed singletons — rebuilding a pool
+        # after a failure (or per serving lane) must not re-pay XLA compiles
+        self._scatter_fn = _paged_scatter_jit()
+        self._cow_fn = _paged_cow_jit()
+
+    # --------------------------------------------------------------- allocator
+    def pages_for(self, tokens: int) -> int:
+        return math.ceil(max(1, int(tokens)) / self.page_size)
+
+    def _fresh_needed(self, tokens: int, matched: int = 0) -> int:
+        """Pages a new request must ALLOCATE (shared full pages bind for free;
+        a partially-covered boundary page costs one copy-on-write page)."""
+        need = self.pages_for(tokens)
+        shared_full = int(matched) // self.page_size
+        return need - shared_full
+
+    def can_admit(self, tokens: Optional[int] = None, matched: int = 0) -> bool:
+        tokens = self.cap if tokens is None else int(tokens)
+        return bool(self._free_slots) and \
+            len(self._free_pages) >= self._fresh_needed(tokens, matched)
+
+    def acquire(self, tokens: Optional[int] = None, prefix_pages=None,
+                matched: int = 0) -> Optional[int]:
+        """Borrow a slot and allocate its pages, or ``None`` when slot or page
+        capacity is exhausted (the caller leaves the request queued).
+
+        ``tokens`` is the reservation (``prompt + max_new``; defaults to
+        ``cap``). With ``prefix_pages``/``matched`` (a prefix-cache hit), the
+        first ``matched // page`` table entries BIND the shared pages
+        (refcount bump, zero-copy) and a partially-covered boundary page is
+        copied into a fresh private page (copy-on-write) so the new slot's
+        suffix writes never touch shared rows."""
+        tokens = self.cap if tokens is None else int(tokens)
+        if tokens > self.cap:
+            raise ValueError(f"reservation {tokens} exceeds cap {self.cap}")
+        matched = int(matched)
+        if prefix_pages is None:
+            matched = 0
+        need = self.pages_for(tokens)
+        shared_full = matched // self.page_size
+        cow = 1 if matched % self.page_size else 0
+        fresh = need - shared_full
+        if not self._free_slots or len(self._free_pages) < fresh:
+            return None
+        if prefix_pages is not None and shared_full + cow > len(prefix_pages):
+            raise ValueError(
+                f"matched={matched} needs {shared_full + cow} prefix pages, "
+                f"entry holds {len(prefix_pages)}")
+        slot = self._free_slots.pop(0)
+        row = self.page_table[slot]
+        n = 0
+        for j in range(shared_full):                   # zero-copy shared bind
+            p = int(prefix_pages[j])
+            self._ref[p] += 1
+            row[n] = p
+            n += 1
+        if cow:                                        # boundary page: COW
+            src = int(prefix_pages[shared_full])
+            dst = self._free_pages.pop(0)
+            self.caches = self._cow_fn(self.caches, np.int32(src),
+                                       np.int32(dst))
+            self.cow_copies_total += 1
+            self._ref[dst] = 1
+            row[n] = dst
+            n += 1
+        for _ in range(need - n):                      # private fresh pages
+            p = self._free_pages.pop(0)
+            self._ref[p] = 1
+            row[n] = p
+            n += 1
+        self._slot_npages[slot] = need
+        self._slot_tokens[slot] = tokens
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Return the slot and decref its pages; pages at refcount 0 go back
+        to the free list (a page the prefix cache still references survives —
+        eviction there is just another refcount drop). No zero-fill: see the
+        module docstring's leak-safety argument."""
+        if slot in self._free_slots:
+            raise ValueError(f"slot {slot} is already free")
+        row = self.page_table[slot]
+        for j in range(int(self._slot_npages[slot])):
+            self._decref(int(row[j]))
+        row[:] = NULL_PAGE
+        self._slot_npages[slot] = 0
+        self._slot_tokens[slot] = 0
+        self._free_slots.append(slot)
+
+    def _decref(self, page: int) -> None:
+        if page == NULL_PAGE:
+            return
+        if self._ref[page] <= 0:
+            raise AssertionError(f"refcount underflow on page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free_pages.append(page)
+
+    # ----------------------------------------------------- prefix page sharing
+    def share_prefix(self, slot: int, tokens: int) -> np.ndarray:
+        """Refcount-bump the slot's pages covering rows ``[0, tokens)`` and
+        return their indices — the prefix cache's zero-copy insert (the paged
+        replacement for the slab gather). The boundary page is shared too: a
+        later hit only trusts its rows below the matched length and
+        copy-on-writes before writing."""
+        n = self.pages_for(tokens)
+        if n > int(self._slot_npages[slot]):
+            raise ValueError(f"slot {slot} holds {self._slot_npages[slot]} "
+                             f"pages, cannot share {n}")
+        pages = self.page_table[slot, :n].copy()
+        for p in pages:
+            self._ref[int(p)] += 1
+        return pages
+
+    def release_shared(self, pages) -> None:
+        """Drop a prefix-cache entry's page references (LRU eviction path)."""
+        for p in pages:
+            self._decref(int(p))
+
+    def page_ref(self, page: int) -> int:
+        """Current refcount of a page (admission-pressure eviction asks which
+        cache entries would actually free pages: exactly those holding a
+        page at refcount 1)."""
+        return int(self._ref[int(page)])
+
+    def table_row(self, slot: int) -> np.ndarray:
+        return self.page_table[slot]
+
+    # ------------------------------------------------------ prefill scatter-in
+    def scatter_prefill(self, slot: int, one_caches: List[Dict[str, Any]]) \
+            -> None:
+        """Write a prefill's dense batch-1 per-layer cache into the slot's
+        pages (the miss-path sibling of the slot pool's row scatter)."""
+        self.caches = self._scatter_fn(self.caches, one_caches,
+                                       jnp.asarray(self.page_table[slot]))
+
+    # --------------------------------------------------------- slab I/O (wire)
+    def slab_nbytes(self, rows: int) -> int:
+        """Host-side size of a dense ``rows``-row slab (serialization API)."""
+        total = 0
+        for c in self.caches:
+            _, hk, _, d = c["k"].shape
+            total += 2 * hk * int(rows) * d * c["k"].dtype.itemsize
+        return total
+
+    def gather_prefix(self, slot: int, rows: int) -> List[Dict[str, Any]]:
+        """Copy rows ``[0, rows)`` of ``slot`` out as an independent dense KV
+        slab — the page-granular serialization API disaggregated prefill
+        ships (NOT donated; the slab's lifetime is the caller's). Underneath
+        it is a page gather sliced to ``rows``."""
+        R = int(rows)
+        if not 0 < R <= self.cap:
+            raise ValueError(f"rows must be in [1, cap={self.cap}], got {R}")
+        return _paged_gather_jit(R)(self.caches,
+                                    jnp.asarray(self.page_table[slot]))
+
+    def restore_prefix(self, slot: int, slab: List[Dict[str, Any]]) -> None:
+        """Write a dense gathered slab into rows ``[0, slab_rows)`` of the
+        slot's pages (donated pool update). Assumes a freshly acquired slot:
+        boundary-page rows beyond the slab are zero-padded, which is exactly
+        the unwritten state they are in."""
+        R = int(slab[0]["k"].shape[1])
+        if R > self.cap:
+            raise ValueError(f"slab rows {R} exceed pool cap {self.cap}")
+        n = self.pages_for(R)
+        if n > int(self._slot_npages[slot]):
+            raise ValueError(f"slot {slot} holds {self._slot_npages[slot]} "
+                             f"pages, slab needs {n}")
+        self.caches = _paged_restore_jit(R)(
+            self.caches, slab, jnp.asarray(self.page_table[slot, :n]))
+
+    # ------------------------------------------------------------------ metrics
+    @property
+    def paged(self) -> bool:
+        return True
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.total_pages - 1 - len(self._free_pages)
+
+    @property
+    def occupancy(self) -> float:
+        """SLOT occupancy — same quantity (and autoscaler signal semantics)
+        as the slot-row pool; page-level utilisation is in :meth:`stats`."""
+        return 1.0 - len(self._free_slots) / self.slots
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages referenced more than once (slot+cache or multi-slot bind)."""
+        return int(np.sum(self._ref > 1))
+
+    @property
+    def page_fragmentation(self) -> float:
+        """Internal fragmentation of slot-held pages: the fraction of
+        allocated page rows beyond the slots' token reservations (allocation
+        granularity waste — the quantity the page-size knob trades against
+        table width)."""
+        pages = int(np.sum(self._slot_npages))
+        if pages == 0:
+            return 0.0
+        reserved = int(np.sum(self._slot_tokens))
+        return 1.0 - reserved / (pages * self.page_size)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "pages_in_use": float(self.pages_in_use),
+            "page_fragmentation": float(self.page_fragmentation),
+            "prefix_shared_pages": float(self.shared_pages),
+            "cow_copies_total": float(self.cow_copies_total),
+            "total_pages": float(self.total_pages - 1),
+            "page_size": float(self.page_size),
+        }

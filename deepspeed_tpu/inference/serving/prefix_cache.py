@@ -66,13 +66,20 @@ def slab_bytes(slab: List[Dict]) -> int:
 
 
 class _Entry:
-    """A cached slab anchored at a trie node (depth == covered token count)."""
-    __slots__ = ("slab", "tokens", "bytes", "node")
+    """A cached prefix anchored at a trie node (depth == covered tokens).
 
-    def __init__(self, slab: List[Dict], tokens: int, node: "_Node"):
+    Two storage forms: ``slab`` — an independent gathered per-layer KV copy
+    (slot-row pool); ``pages`` — REFCOUNTED physical page indices into the
+    paged pool (zero-copy: a hit binds them into the new slot's table, an
+    eviction is a refcount drop via the owner's ``page_release`` hook)."""
+    __slots__ = ("slab", "tokens", "bytes", "node", "pages")
+
+    def __init__(self, slab: Optional[List[Dict]], tokens: int, node: "_Node",
+                 pages=None, nbytes: Optional[int] = None):
         self.slab = slab            # per-layer {"k": (hk, R, d), "v": ...}
+        self.pages = pages          # np (n,) physical page indices, or None
         self.tokens = int(tokens)   # real covered rows (== node depth)
-        self.bytes = slab_bytes(slab)
+        self.bytes = int(nbytes) if nbytes is not None else slab_bytes(slab)
         self.node = node
 
 
@@ -102,6 +109,12 @@ class PrefixCache:
 
     def __init__(self, config: Optional[PrefixCacheConfig] = None):
         self.config = config or PrefixCacheConfig()
+        # paged mode: the pool's release_shared, set by the owning scheduler —
+        # LRU eviction of a page entry decrefs through it, and so does
+        # clear(): against a still-live pool (idle-replica revive) the pages
+        # must return to the free list or they leak forever; against a pool
+        # about to be discarded (_rebuild_pool) the decref is harmless.
+        self.page_release = None
         self.root = _Node(np.zeros(0, np.int32), None, 0)
         self._lru: "OrderedDict[int, _Entry]" = OrderedDict()  # id(entry) keyed
         self.total_bytes = 0
@@ -127,6 +140,24 @@ class PrefixCache:
         """
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         self.lookup_tokens += int(prompt.size)
+        usable, entry = self._match(prompt)
+        if entry is None:
+            self.misses += 1
+            return 0, None
+        self.hits += 1
+        self.hit_tokens += usable
+        self._touch(entry)
+        return usable, entry
+
+    def peek(self, prompt) -> Tuple[int, Optional[_Entry]]:
+        """What ``lookup`` would return, with no side effects: no hit/miss
+        counters, no LRU touch. Admission-pressure eviction peeks the head
+        request's prefix to know which entry it must NOT evict (and how many
+        fresh pages the head actually needs) without double-counting the
+        real lookup that follows on admission."""
+        return self._match(np.asarray(prompt, dtype=np.int32).reshape(-1))
+
+    def _match(self, prompt: np.ndarray) -> Tuple[int, Optional[_Entry]]:
         node, i = self.root, 0
         best_anchor: Optional[_Entry] = None     # deepest full-node entry
         best_anchor_len = 0
@@ -154,11 +185,7 @@ class PrefixCache:
                 matched, entry = i, deeper
         usable = min(matched, int(prompt.size) - 1)
         if entry is None or usable < max(1, self.config.min_hit_tokens):
-            self.misses += 1
             return 0, None
-        self.hits += 1
-        self.hit_tokens += usable
-        self._touch(entry)
         return usable, entry
 
     def contains(self, prompt) -> bool:
@@ -218,6 +245,33 @@ class PrefixCache:
         self._evict_to_budget(keep=entry)
         return True
 
+    def insert_pages(self, prompt, pages, nbytes: int) -> bool:
+        """Paged-pool insert: index refcounted page indices under the prompt
+        path. Returns True when the cache TOOK OWNERSHIP of the caller's page
+        references; False (too short / over budget / already resident) means
+        the caller must release them. ``nbytes`` counts whole pages and may
+        double-count physically shared pages across entries — the budget is
+        an upper bound on distinct bytes, never an undercount."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size < max(1, self.config.min_insert_tokens):
+            self.insert_skipped += 1
+            return False
+        if nbytes > self.config.max_bytes:
+            self.insert_skipped += 1
+            return False
+        node = self._descend(prompt)
+        if node.entry is not None:
+            self._touch(node.entry)      # resident: keep its refs, drop yours
+            return False
+        entry = _Entry(None, prompt.size, node, pages=np.asarray(pages),
+                       nbytes=nbytes)
+        node.entry = entry
+        self._lru[id(entry)] = entry
+        self.total_bytes += entry.bytes
+        self.inserted += 1
+        self._evict_to_budget(keep=entry)
+        return True
+
     def _descend(self, tokens: np.ndarray) -> _Node:
         """Walk/extend/split the trie so a node exists exactly at ``tokens``."""
         node, i = self.root, 0
@@ -255,10 +309,29 @@ class PrefixCache:
             evicted += 1
         return evicted
 
+    def evict_lru(self, predicate=None) -> bool:
+        """Evict the least-recently-used entry matching ``predicate``
+        (admission-pressure eviction: on the paged pool, cached prefixes pin
+        real pool pages, so when admission runs out of free pages the
+        scheduler trades cold cached prefixes for admission capacity). The
+        predicate lets the caller skip entries whose eviction would free
+        nothing — an entry all of whose pages are still bound by live slots
+        is pure loss to drop, since the pages stay allocated either way.
+        Returns False when nothing eligible remains."""
+        for entry in self._lru.values():
+            if predicate is None or predicate(entry):
+                self._remove(entry)
+                return True
+        return False
+
     def _remove(self, entry: _Entry) -> None:
         del self._lru[id(entry)]
         self.total_bytes -= entry.bytes
         self.evicted += 1
+        if entry.pages is not None and self.page_release is not None:
+            # paged eviction IS a refcount drop: pages still bound by live
+            # slots survive in the pool until those slots release too
+            self.page_release(entry.pages)
         node = entry.node
         node.entry = None
         # prune entry-less leaf chains so the trie doesn't accrete dead paths
@@ -269,7 +342,14 @@ class PrefixCache:
             node = parent
 
     def clear(self) -> None:
-        """Drop everything (models HBM loss on replica process death)."""
+        """Drop everything (models HBM loss on replica process death). Paged
+        entries decref through ``page_release`` first — without it an idle
+        replica's revive would strand every cached prefix's refcounts in the
+        still-live pool (see ``__init__``)."""
+        if self.page_release is not None:
+            for entry in self._lru.values():
+                if entry.pages is not None:
+                    self.page_release(entry.pages)
         self.root = _Node(np.zeros(0, np.int32), None, 0)
         self._lru.clear()
         self.total_bytes = 0

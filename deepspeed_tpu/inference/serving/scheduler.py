@@ -88,6 +88,14 @@ class ServingConfig:
     base_seed: int = 0
     chunk_deadline_s: Optional[float] = None   # per-chunk watchdog (None = off)
     prefix_cache: Optional[PrefixCacheConfig] = None   # None = cache off
+    # KV memory shape: "paged" (default) = global fixed-size pages behind
+    # per-slot page tables, page-count admission, zero-copy refcounted prefix
+    # sharing; "slots" = the legacy slot-row pool (one cap-row reservation
+    # per slot). Greedy output is bit-identical either way.
+    kv_pool: str = "paged"
+    kv_page_size: int = 16
+    kv_total_pages: Optional[int] = None   # HBM budget in pages (None = match
+    #   the slot-row pool's bytes: slots * ceil(cap/page) + the null page)
 
 
 def validate_admission(prompt, max_new_tokens: Optional[int],
@@ -165,13 +173,20 @@ class ContinuousBatchingScheduler:
             do_sample=cfg.do_sample, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p,
             max_prompt_len=cfg.max_prompt_len, base_seed=cfg.base_seed,
-            chunk_deadline_s=cfg.chunk_deadline_s)
+            chunk_deadline_s=cfg.chunk_deadline_s, kv_pool=cfg.kv_pool,
+            kv_page_size=cfg.kv_page_size, kv_total_pages=cfg.kv_total_pages)
         self.cap = cap
         self.telemetry = ServingTelemetry(monitor)
         self._tracer = get_tracer()
         self.prefix_cache: Optional[PrefixCache] = None
         if cfg.prefix_cache is not None and cfg.prefix_cache.enabled:
             self.prefix_cache = PrefixCache(cfg.prefix_cache)
+            if self.executor.paged:
+                # LRU eviction of a page entry decrefs against the CURRENT
+                # pool (any pool swap clears the cache first, so an entry's
+                # pages always belong to the pool this resolves to)
+                self.prefix_cache.page_release = \
+                    lambda pages: self.executor.pool.release_shared(pages)
         self.queue: Deque[RequestHandle] = deque()
         self._ids = itertools.count()
         S = cfg.slots
@@ -245,10 +260,12 @@ class ContinuousBatchingScheduler:
         self._sweep_running(now)
         admitted = self._admit()
         decoded = self._decode_chunk()
+        pool = self.executor.pool
         self.telemetry.on_step(
-            len(self.queue), self.executor.pool.occupancy,
+            len(self.queue), pool.occupancy,
             prefix_stats=(None if self.prefix_cache is None
-                          else self.prefix_cache.stats()))
+                          else self.prefix_cache.stats()),
+            paged_stats=(pool.stats() if pool.paged else None))
         return admitted or decoded
 
     def run(self, max_steps: int = 100000) -> dict:
@@ -262,8 +279,10 @@ class ContinuousBatchingScheduler:
 
     # ------------------------------------------------------------ prefix cache
     def _insert_prefix(self, handle: RequestHandle, slot: int) -> None:
-        """Gather the slot's prompt-KV rows (padded to the prompt bucket) and
-        index them in the trie under the full prompt token path."""
+        """Index the slot's prompt KV in the trie under the full prompt token
+        path. Paged pool: SHARE the slot's prompt-covering pages (refcount
+        bump — zero-copy, no device gather at all). Slot-row pool: gather a
+        slab copy (padded to the prompt bucket) as before."""
         if self.prefix_cache is None:
             return
         P = int(handle.prompt.size)
@@ -273,12 +292,22 @@ class ContinuousBatchingScheduler:
         if self.prefix_cache.contains(handle.prompt):
             return                   # resident (LRU refreshed): same tokens ⇒
             #   bit-identical slab, don't pay the gather to drop it
+        pool = self.executor.pool
+        if pool.paged:
+            nbytes = pool.pages_for(P) * pool.page_nbytes
+            if nbytes > self.prefix_cache.config.max_bytes:
+                self.prefix_cache.insert_skipped += 1
+                return
+            pages = pool.share_prefix(slot, P)
+            if not self.prefix_cache.insert_pages(handle.prompt, pages,
+                                                  nbytes):
+                pool.release_shared(pages)   # resident/refused: drop our refs
+            return
         rows = self.executor.bucket_for(P)
-        if self.executor.pool.slab_nbytes(rows) > \
-                self.prefix_cache.config.max_bytes:
+        if pool.slab_nbytes(rows) > self.prefix_cache.config.max_bytes:
             self.prefix_cache.insert_skipped += 1
             return                   # could never fit: skip the gather too
-        slab = self.executor.pool.gather_prefix(slot, rows)
+        slab = pool.gather_prefix(slot, rows)
         self.prefix_cache.insert(handle.prompt, slab)
 
     def _retire_prefix(self, handle: RequestHandle, slot: int) -> None:
@@ -323,6 +352,16 @@ class ContinuousBatchingScheduler:
             "prefill_tokens_skipped_frac": s["hit_tokens"] / seen,
         }
 
+    def _rebuild_pool(self) -> None:
+        """Discard + rebuild the KV pool after a failure that may have
+        consumed donated buffers. On the paged pool the prefix cache's shared
+        pages live INSIDE the discarded buffers, so its entries are cleared
+        with it — the honest cost of zero-copy sharing (slab-mode entries are
+        independent gathered copies and survive, as before)."""
+        if self.executor.paged and self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        self.executor.reset_pool()
+
     # --------------------------------------------------------------- eviction
     def evict_all(self, reason: str = "evicted") -> List[RequestHandle]:
         """Evict every queued and in-flight request with its generated-so-far
@@ -352,7 +391,7 @@ class ContinuousBatchingScheduler:
         self._eos[:] = -1
         # rebuild rather than per-slot zero-fill: on the death path the old
         # buffers may be inside a failed/wedged dispatch and cannot be trusted
-        self.executor.reset_pool()
+        self._rebuild_pool()
         return out
 
     # ----------------------------------------------------------------- sweeps
@@ -389,9 +428,43 @@ class ContinuousBatchingScheduler:
         admitted = False
         cfg = self.config
         tracer = self._tracer
-        while self.queue and self.executor.pool.free_slots > 0:
+        while self.queue:
+            pool = self.executor.pool    # re-read: a failed hit-prefill below
+            head = self.queue[0]         # rebuilds the pool mid-loop
+            # page-count admission: the paged pool admits when the request's
+            # OWN reservation (prompt + budget, page-granular) fits — not when
+            # a whole cap-row slot frees up. Conservative (all-fresh) check:
+            # a prefix hit can only need fewer pages. The slot pool reduces
+            # to its free-slot check. FIFO: a head that doesn't fit waits.
+            need_tokens = int(head.prompt.size) + int(head.max_new_tokens)
+            if not pool.can_admit(need_tokens):
+                # admission-pressure eviction (paged): cached prefixes pin
+                # real pool pages, so a full free list trades the coldest
+                # cached prefixes for admission capacity before giving up —
+                # a waiting request always outranks a cold cached prefix.
+                # Only entries holding a refcount-1 page are worth dropping:
+                # evicting one whose pages live slots still bind frees
+                # nothing, and would just empty the cache for no capacity.
+                # Peek the head's own prefix first (stats/LRU-free): its
+                # matching entry must survive the sweep — evicting it would
+                # trade the head's zero-copy hit for a full prefill — and a
+                # hit shrinks the fresh-page need to the unshared suffix.
+                # ... but ONLY when pages are the shortage: evicting cached
+                # prefixes frees pages, never slots, so a queue blocked on a
+                # full slot set must not drain the cache for zero gain.
+                matched_hint = 0
+                if pool.paged and self.prefix_cache is not None \
+                        and pool.free_slots > 0:
+                    matched_hint, keep = self.prefix_cache.peek(head.prompt)
+                    frees = lambda e: e is not keep and any(  # noqa: E731
+                        pool.page_ref(p) == 1 for p in e.pages)
+                    while not pool.can_admit(need_tokens,
+                                             matched=matched_hint) and \
+                            self.prefix_cache.evict_lru(frees):
+                        pass
+                if not pool.can_admit(need_tokens, matched=matched_hint):
+                    break
             handle = self.queue.popleft()
-            slot = self.executor.pool.acquire()
             admit_t = time.monotonic()
             tracer.record_span("queue_wait", handle._span,
                                handle.arrival, admit_t)
@@ -403,6 +476,17 @@ class ContinuousBatchingScheduler:
                                    time.monotonic(),
                                    attrs={"hit": entry is not None,
                                           "matched_tokens": int(matched)})
+            if pool.paged and entry is not None:
+                # zero-copy hit: bind the shared prefix pages into the fresh
+                # slot's table (refcount bump + one COW boundary page) — the
+                # paged replacement for the slab restore scatter
+                slot = pool.acquire(need_tokens, prefix_pages=entry.pages,
+                                    matched=matched)
+            else:
+                slot = pool.acquire(need_tokens)
+            if slot is None:   # can_admit is conservative, so only a racing
+                self.queue.appendleft(handle)          # caller could land here
+                break
 
             def attempt(h=handle, s=slot, m=matched, e=entry):
                 fault_point("serving.prefill")
@@ -454,7 +538,7 @@ class ContinuousBatchingScheduler:
                     self._remaining[:] = 0
                     self._steps[:] = 0
                     self._eos[:] = -1
-                    self.executor.reset_pool()
+                    self._rebuild_pool()
                 else:
                     self._release(slot)
                 continue
@@ -526,7 +610,7 @@ class ContinuousBatchingScheduler:
             self._remaining[:] = 0
             self._steps[:] = 0
             self._eos[:] = -1
-            self.executor.reset_pool()
+            self._rebuild_pool()
             return False
         now = time.monotonic()
         counts = res.steps - steps_before

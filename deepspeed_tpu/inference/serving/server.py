@@ -276,6 +276,15 @@ def main(argv=None) -> int:
                     help="training checkpoint dir to serve")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--chunk-size", type=int, default=8)
+    ap.add_argument("--kv-pool", default="paged", choices=("paged", "slots"),
+                    help="KV memory shape: 'paged' (default) = fixed-size "
+                         "pages behind per-slot page tables (page-count "
+                         "admission, zero-copy refcounted prefix sharing); "
+                         "'slots' = legacy cap-row-per-slot pool")
+    ap.add_argument("--kv-page-size", type=int, default=16,
+                    help="KV page size in tokens (paged pool; default 16). "
+                         "Must be a positive multiple of --chunk-size so "
+                         "page boundaries stay chunk-aligned")
     ap.add_argument("--max-queue", type=int, default=32)
     ap.add_argument("--replicas", type=int, default=1,
                     help=">=2 serves through the multi-replica router")
@@ -361,11 +370,20 @@ def main(argv=None) -> int:
             max_bytes=int(args.prefix_cache_mb * 1024 * 1024),
             min_hit_tokens=args.prefix_min_hit,
             min_insert_tokens=args.prefix_min_hit)
+    if args.kv_pool == "paged" and (
+            args.kv_page_size < 1
+            or args.kv_page_size % args.chunk_size != 0):
+        raise SystemExit(
+            f"--kv-page-size {args.kv_page_size} must be a positive multiple "
+            f"of --chunk-size {args.chunk_size} (page boundaries stay "
+            "chunk-aligned)")
     serving_cfg = ServingConfig(slots=args.slots, chunk_size=args.chunk_size,
                                 max_queue=args.max_queue,
                                 max_seq_len=args.max_seq_len,
                                 chunk_deadline_s=args.chunk_deadline,
-                                prefix_cache=prefix_cfg)
+                                prefix_cache=prefix_cfg,
+                                kv_pool=args.kv_pool,
+                                kv_page_size=args.kv_page_size)
     monitor = _make_monitor(args)
     chaos = None
     autoscaler = None

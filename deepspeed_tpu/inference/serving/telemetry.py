@@ -89,6 +89,7 @@ class ServingTelemetry:
         self.prefix_misses = 0
         self.prefix_hit_tokens = 0
         self._prefix_stats = None    # latest PrefixCache.stats() gauge set
+        self._paged_stats = None     # latest PagedKVPool.stats() gauge set
         # completion timestamps (bounded): the observed drain rate behind the
         # load-adaptive QueueFullError.retry_after hint
         self._finish_times = deque(maxlen=64)
@@ -101,12 +102,24 @@ class ServingTelemetry:
             self.monitor.write_events(events)
 
     def on_step(self, queue_depth: int, occupancy: float,
-                prefix_stats=None) -> None:
+                prefix_stats=None, paged_stats=None) -> None:
         self._tick += 1
         ev = [("serving/queue_depth", float(queue_depth), self._tick),
               ("serving/slot_occupancy", float(occupancy), self._tick),
               ("serving/completed_total", float(self.completed), self._tick),
               ("serving/rejected_total", float(self.rejected), self._tick)]
+        if paged_stats is not None:
+            # paged-pool gauges/counters (PagedKVPool.stats()): page-granular
+            # occupancy, allocation-granularity waste, zero-copy sharing
+            self._paged_stats = paged_stats
+            ev += [("serving/pages_in_use",
+                    float(paged_stats["pages_in_use"]), self._tick),
+                   ("serving/page_fragmentation",
+                    float(paged_stats["page_fragmentation"]), self._tick),
+                   ("serving/prefix_shared_pages",
+                    float(paged_stats["prefix_shared_pages"]), self._tick),
+                   ("serving/cow_copies_total",
+                    float(paged_stats["cow_copies_total"]), self._tick)]
         if prefix_stats is not None:
             self._prefix_stats = prefix_stats
             # hit_rate here is ADMISSION-level (successful prefills), the same
@@ -193,8 +206,11 @@ class ServingTelemetry:
                 prefix["prefix_evicted"] = self._prefix_stats["evicted"]
                 prefix["prefix_cached_bytes"] = \
                     self._prefix_stats["cached_bytes"]
+        paged = ({f"paged_{k}": v for k, v in self._paged_stats.items()}
+                 if self._paged_stats is not None else {})
         return {
             **prefix,
+            **paged,
             "elapsed_s": elapsed,
             "completed": self.completed,
             "rejected": self.rejected,

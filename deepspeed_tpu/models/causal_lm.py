@@ -453,13 +453,21 @@ class CausalLMLayer(nn.Module):
     @nn.compact
     def __call__(self, x, positions, cache: Optional[Dict] = None,
                  cache_len: Optional[jnp.ndarray] = None,
-                 prefix_fill: bool = False):
+                 prefix_fill: bool = False, page_table=None,
+                 kv_cap: Optional[int] = None):
         """x: (b, t, d). With ``cache`` given (decode): t==1, attention against the cache.
         With ``prefix_fill`` (static): suffix prefill at a nonzero cache offset —
         ``cache`` already holds a restored prompt-prefix KV slab in rows
         ``[0, cache_len)``, the t suffix tokens write their K/V at rows
         ``cache_len + i`` and attend over prefix + suffix (the prefix-cache hit
         path: the prefix's prefill compute is skipped entirely).
+
+        With ``page_table`` (decode only): ``cache`` holds GLOBAL KV pages
+        ``{"k": (P, hk, page, d), ...}`` and the ``(b, max_pages)`` table maps
+        each row's positions to physical pages — the step appends K/V at the
+        page-mapped row and attends through the paged-attention op (XLA dense
+        gather sliced to ``kv_cap`` rows = bit-identical to the slot-row
+        cache; Pallas gather-by-page-index kernel on TPU).
         Returns (y, new_cache_kv or None)."""
         cfg = self.config
         b, t, _ = x.shape
@@ -473,7 +481,27 @@ class CausalLMLayer(nn.Module):
                   if cfg.pos_emb == "alibi" else None)
 
         new_kv = None
-        if cache is not None and t == 1:
+        if cache is not None and t == 1 and page_table is not None:
+            # paged decode: append at the page-mapped row, attend by page index
+            from ..ops.paged_attention import (gather_kv_dense,
+                                               paged_attention,
+                                               paged_cache_update)
+            cap = int(kv_cap if kv_cap is not None
+                      else page_table.shape[1] * cache["k"].shape[2])
+            k_hm = k.transpose(0, 2, 1, 3)   # (b, hk, 1, d)
+            v_hm = v.transpose(0, 2, 1, 3)
+            k_pages, v_pages = paged_cache_update(
+                cache["k"], cache["v"], k_hm, v_hm, page_table, cache_len)
+            new_kv = {"k": k_pages, "v": v_pages}
+            lens1 = cache_len + 1
+            if slopes is not None:
+                kd, vd = gather_kv_dense(k_pages, v_pages, page_table, cap)
+                o = decode_attention_xla_alibi(q[:, 0], kd, vd, lens1,
+                                               slopes)[:, None]
+            else:
+                o = paged_attention(q[:, 0], k_pages, v_pages, page_table,
+                                    lens1, cap)[:, None]
+        elif cache is not None and t == 1:
             # decode: append to cache (head-major), fused decode kernel
             k_hm = k.transpose(0, 2, 1, 3)   # (b, hk, 1, d)
             v_hm = v.transpose(0, 2, 1, 3)
@@ -657,7 +685,8 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, caches=None, cache_lens=None,
-                 logits_positions=None, prefix_fill=False):
+                 logits_positions=None, prefix_fill=False, page_table=None,
+                 kv_cap=None):
         """``logits_positions`` (b,): compute the LM head ONLY at these sequence
         positions (serving prefill needs just each prompt's last valid token — for a
         250k vocab at t=512 this removes ~99.8% of the head matmul and the (b, t, V)
@@ -688,7 +717,8 @@ class CausalLM(nn.Module):
             x, new_kv = CausalLMLayer(cfg, is_moe=cfg.is_moe_layer(i),
                                       name=f"layers_{i}")(
                 x, positions, cache=layer_cache, cache_len=cache_lens,
-                prefix_fill=prefix_fill)
+                prefix_fill=prefix_fill, page_table=page_table,
+                kv_cap=kv_cap)
             new_caches.append(new_kv)
 
         x = _norm(cfg, "ln_f")(x)

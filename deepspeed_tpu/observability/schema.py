@@ -36,6 +36,15 @@ TAGS: Dict[str, Tuple[str, str]] = {
     "serving/prefix_hit_rate": (GAUGE, "admission-level prefix-cache hit rate"),
     "serving/prefix_cached_bytes": (GAUGE, "resident prefix-slab bytes"),
     "serving/prefix_evicted_total": (COUNTER, "prefix-cache LRU evictions"),
+    # ------------------------------------------------- paged KV pool (PR 13)
+    "serving/pages_in_use": (GAUGE, "allocated KV pages per scheduler tick"),
+    "serving/page_fragmentation": (GAUGE, "allocation-granularity waste: "
+                                          "fraction of allocated page rows "
+                                          "beyond slot reservations"),
+    "serving/prefix_shared_pages": (GAUGE, "pages referenced more than once "
+                                           "(zero-copy prefix sharing)"),
+    "serving/cow_copies_total": (COUNTER, "copy-on-write boundary-page "
+                                          "copies at prefix bind"),
     # ------------------------------------------------------------------ router
     "router/queue_depth": (GAUGE, "router admission queue depth per tick"),
     "router/retried_total": (COUNTER, "checkpointless retries (re-enqueues)"),

@@ -58,6 +58,11 @@ def pytest_configure(config):
         "collective-schema cross-check, AST rules, ds-tpu-lint JSON smoke) "
         "— tier-1 fast lane")
     config.addinivalue_line(
+        "markers", "paged_kv: paged KV memory lane (page allocator, refcount "
+        "+ copy-on-write lifecycle, paged-attention kernel-vs-XLA parity, "
+        "hit/miss/retry/drain/migration bit-exactness, page-bind chaos "
+        "kill, bench --bench-paged smoke) — tier-1 fast lane")
+    config.addinivalue_line(
         "markers", "serving_autoscale: elastic control plane lane "
         "(autoscaler scale-up/down, hysteresis, SLO admission shed-vs-"
         "expire, degradation ladder, drain-parity on scale-down, "
@@ -83,6 +88,7 @@ def pytest_collection_modifyitems(config, items):
         if "inference/serving" in it.nodeid \
                 or it.get_closest_marker("serving_router") is not None \
                 or it.get_closest_marker("prefix_cache") is not None \
+                or it.get_closest_marker("paged_kv") is not None \
                 or it.get_closest_marker("serving_autoscale") is not None:
             return 3
         if it.get_closest_marker("comm_overlap") is not None:

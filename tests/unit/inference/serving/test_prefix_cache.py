@@ -175,8 +175,10 @@ def test_suffix_bucket_selection(engine):
     assert h1.prefix_hit_tokens == 24
     new_keys = set(engine._fns.keys()) - keys_before
     # suffix is 4 tokens -> smallest (8) bucket, NOT the 32 bucket p1's full
-    # 28-token length would have needed
-    assert ("serve_suffix_prefill", 2, CAP, 8, ex.sampling) in new_keys
+    # 28-token length would have needed (paged pool: the key carries the page
+    # geometry instead of the slot count — pages are slot-agnostic)
+    assert ("serve_suffix_prefill_paged", ex.pool.total_pages,
+            ex.pool.page_size, CAP, 8, ex.sampling) in new_keys
     full_buckets = [k for k in new_keys if k[0] == "serve_prefill"]
     assert not full_buckets
 
@@ -457,8 +459,12 @@ def test_subprocess_replica_sigkill_retry_parity(engine):
     from deepspeed_tpu.inference.serving.subproc import SubprocessReplica
     from deepspeed_tpu.utils.fault_injection import FaultSpec, fault_env
 
+    # a real (small) per-chunk delay, not just an armed no-op: the paged
+    # chunk's first compile is long enough that an unpaced child can stream
+    # every token before the parent's mid-decode kill lands — the delay
+    # deterministically spaces the chunks the kill must fall between
     env = fault_env([("serving.decode_chunk",
-                      FaultSpec(kind="delay", prob=0.0))], seed=3)
+                      FaultSpec(kind="delay", delay_s=0.05))], seed=3)
     rep = SubprocessReplica(REPO, env=env, prefix_cache=True,
                             vocab_size=TINY["vocab_size"],
                             max_seq_len=TINY["max_seq_len"],
