@@ -871,6 +871,108 @@ def bench_wq(smoke: bool = False, out_path: str = None):
     return 0
 
 
+def bench_trajectory(root: str = ".", out_json: str = "BENCH_TRAJECTORY.json",
+                     out_md: str = "BENCH_TRAJECTORY.md") -> dict:
+    """Scrape every ``BENCH_*.json`` headline + gate verdict into ONE
+    machine-readable perf record (``--trajectory``).
+
+    The per-PR bench artifacts carry their own shapes (``metric``/``value``
+    headlines, ``*_gates`` dicts with in-file booleans, the round-1 wrapper's
+    nested ``parsed``, the NORTHSTAR ``results`` lists); this walks them all
+    tolerantly and emits one row per artifact — file, PR round (from the
+    ``_rNN`` suffix), headline metric, gate pass-count, and overall verdict —
+    plus a markdown table, so "is the perf record still green, and what did
+    each PR claim?" is one file instead of fifteen."""
+    import glob
+    import re as _re
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+        name = os.path.basename(path)
+        if name.startswith("BENCH_TRAJECTORY"):
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"file": name, "error": f"{type(e).__name__}: {e}"})
+            continue
+        m = _re.search(r"_r(\d+)", name)
+        head = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+            else doc
+        if head.get("metric") is None and isinstance(doc.get("results"),
+                                                     list):
+            # NORTHSTAR shape: a list of measurement dicts — headline on the
+            # first, count the rest
+            results = [r for r in doc["results"] if isinstance(r, dict)]
+            head = results[0] if results else {}
+        gates = None
+        for key in sorted(doc):
+            if (key.endswith("gates") or key == "acceptance") \
+                    and isinstance(doc[key], dict):
+                gates = doc[key]
+                break
+        n_true = n_bool = 0
+        if gates is not None:
+            for v in gates.values():
+                if isinstance(v, bool):
+                    n_bool += 1
+                    n_true += int(v)
+        gates_ok = doc.get("gates_ok")
+        if gates_ok is None and n_bool:
+            gates_ok = n_true == n_bool
+        rows.append({
+            "file": name,
+            "round": int(m.group(1)) if m else None,
+            "metric": head.get("metric"),
+            "value": head.get("value"),
+            "unit": head.get("unit"),
+            "smoke": doc.get("smoke"),
+            "gates_true": n_true if n_bool else None,
+            "gates_total": n_bool if n_bool else None,
+            "gates_ok": gates_ok,
+        })
+    rows.sort(key=lambda r: (r.get("round") is None, r.get("round") or 0,
+                             r["file"]))
+    md_lines = [
+        "# Bench trajectory",
+        "",
+        "One row per committed `BENCH_*.json` artifact "
+        "(regenerate with `python bench.py --trajectory`).",
+        "",
+        "| file | round | metric | value | unit | smoke | gates | ok |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        if "error" in r:
+            md_lines.append(f"| {r['file']} |  | (unreadable: {r['error']}) "
+                            "|  |  |  |  |  |")
+            continue
+        val = r["value"]
+        val = f"{val:.4g}" if isinstance(val, (int, float)) else (val or "")
+        gates = (f"{r['gates_true']}/{r['gates_total']}"
+                 if r["gates_total"] else "")
+        ok = {True: "✓", False: "✗", None: ""}[r["gates_ok"]]
+        md_lines.append(
+            f"| {r['file']} | {r['round'] if r['round'] is not None else ''} "
+            f"| {r['metric'] or ''} | {val} | {r['unit'] or ''} "
+            f"| {'y' if r['smoke'] else ''} | {gates} | {ok} |")
+    md = "\n".join(md_lines) + "\n"
+    out = {"metric": "bench_trajectory", "artifacts": len(rows),
+           # an unreadable artifact is a broken perf record, not a pass;
+           # gate-less old artifacts (gates_ok None) still count as ok
+           "all_gates_ok": all(r.get("gates_ok") is not False
+                               and "error" not in r for r in rows),
+           "rows": rows}
+    with open(os.path.join(root, out_json), "w") as f:
+        json.dump(out, f, indent=1)
+    with open(os.path.join(root, out_md), "w") as f:
+        f.write(md)
+    print(json.dumps({"metric": "bench_trajectory", "artifacts": len(rows),
+                      "out": out_json, "md": out_md,
+                      "all_gates_ok": out["all_gates_ok"]}))
+    return out
+
+
 _KERNEL_GATE = None
 
 
@@ -904,9 +1006,17 @@ def main():
     p.add_argument("--smoke", action="store_true",
                    help="with --overlap/--wq: tiny shapes, CPU-safe — asserts "
                         "the A/B harness runs and the JSON is valid")
+    p.add_argument("--trajectory", action="store_true",
+                   help="scrape every BENCH_*.json gate/headline into "
+                        "BENCH_TRAJECTORY.json + a markdown table (the "
+                        "machine-readable per-PR perf record); runs offline, "
+                        "no model builds")
     p.add_argument("--out", default=None,
                    help="with --overlap/--wq: output JSON path")
     args = p.parse_args()
+    if args.trajectory:
+        bench_trajectory()
+        return 0
     if args.smoke and not (args.overlap or args.wq):
         p.error("--smoke requires --overlap or --wq")
     if args.overlap and args.wq:

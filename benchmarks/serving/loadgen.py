@@ -960,9 +960,17 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-out", default=None,
                     help="enable request-scoped tracing; write a Perfetto-"
                          "loadable Chrome trace here at the end of the run")
+    ap.add_argument("--flight-out", default=None,
+                    help="enable the tail-latency flight recorder + anomaly "
+                         "detector (implies tracing) and write the Perfetto-"
+                         "loadable flight bundle here at the end of the run; "
+                         "the BENCH detail gains the per-request attribution "
+                         "breakdown (phase shares at p50 vs p99)")
     ap.add_argument("--obs-ab", action="store_true",
-                    help="tracing-overhead A/B: interleaved off/on reps over "
-                         "one engine; BENCH JSON gates TPOT overhead < 2%%")
+                    help="observability-overhead A/B: interleaved "
+                         "off/tracing/flight reps over one engine; BENCH "
+                         "JSON gates TPOT overhead < 2%% for tracing AND for "
+                         "tracing+attribution+flight+anomaly")
     ap.add_argument("--obs-reps", type=int, default=3,
                     help="repetitions per arm of the --obs-ab run")
     ap.add_argument("--smoke", action="store_true",
@@ -1076,6 +1084,12 @@ def main(argv=None) -> int:
         monitor = MonitorMaster(MonitorConfig(jsonl_monitor={
             "enabled": True, "output_path": args.jsonl_metrics,
             "job_name": "loadgen"}))
+    if (args.bench_paged or args.bench_autoscale) \
+            and (args.flight_out or args.trace_out):
+        # these lanes dispatch before the tracer/flight wiring: refusing
+        # beats silently writing no bundle the caller asked for
+        ap.error("--bench-paged/--bench-autoscale manage their own runs; "
+                 "--trace-out/--flight-out are single-run options")
     if args.bench_paged:
         # dispatched before serving_cfg: the bench pins its own per-lane
         # geometries (and --kv-page-size may be None = per-lane default here)
@@ -1100,16 +1114,29 @@ def main(argv=None) -> int:
         if args.replicas > 1 or args.chaos:
             ap.error("--obs-ab measures the single-scheduler hot path; "
                      "drop --replicas/--chaos")
-        if args.trace_out:
-            ap.error("--obs-ab manages tracing itself (on/off arms); "
-                     "--trace-out is a single-run option")
+        if args.trace_out or args.flight_out:
+            ap.error("--obs-ab manages tracing/flight itself (per-arm); "
+                     "--trace-out/--flight-out are single-run options")
         return _run_obs_ab(args, serving_cfg)
     if args.bench_autoscale:
         return _run_autoscale_bench(args, serving_cfg, monitor)
     from deepspeed_tpu.observability.trace import get_tracer
     tracer = None
-    if args.trace_out:
+    if args.trace_out or args.flight_out:
         tracer = get_tracer().enable(pid_label="loadgen")
+    recorder = detector = None
+    if args.flight_out:
+        from deepspeed_tpu.observability import (AnomalyDetector,
+                                                 FlightRecorder, get_registry)
+        from deepspeed_tpu.observability.anomaly import install_detector
+        # monitor= mirrors the per-request attribution events into
+        # --jsonl-metrics (latency/e2e_ms + latency/phase/* rows per
+        # completion) without double-writing the telemetry tags
+        recorder = FlightRecorder(dump_path=args.flight_out,
+                                  monitor=monitor).attach(tracer)
+        detector = AnomalyDetector(recorder=recorder)
+        install_detector(detector)
+        get_registry().attach_monitor(detector)
     # SLO admission lives on the Router: --slo-admission must not silently
     # degrade to the admission-blind single-scheduler path
     if args.replicas > 1 or args.autoscale or args.slo_admission:
@@ -1126,6 +1153,10 @@ def main(argv=None) -> int:
         from deepspeed_tpu.inference.serving import ChaosSchedule, parse_chaos
         chaos = ChaosSchedule(parse_chaos(args.chaos))
     detail = run_load(front, args, chaos=chaos, autoscaler=autoscaler)
+    if recorder is not None:
+        # "where did the p99 go": phase shares at p50 vs p99 over the run's
+        # attribution rows, in the artifact next to the latency percentiles
+        detail["attribution"] = recorder.breakdown()
     out = {"metric": "serving_tokens_per_sec",
            "value": detail["tokens_per_sec"], "unit": "tok/s",
            "vs_baseline": 0.0, "smoke": bool(args.smoke),
@@ -1148,10 +1179,20 @@ def main(argv=None) -> int:
                                              and hit_p50 <= 0.25 * miss_p50),
             "parity_ok": detail.get("parity_ok", True),
         }
+    if recorder is not None:
+        from deepspeed_tpu.observability import get_registry
+        from deepspeed_tpu.observability.anomaly import install_detector
+        path = recorder.dump(args.flight_out, reason="end_of_run")
+        out["flight"] = {"path": path, "anomaly_trips": detector.trips,
+                         **recorder.stats()}
+        get_registry().detach_monitor(detector)
+        install_detector(None)
+        recorder.detach()
     if tracer is not None:
-        n = tracer.export_chrome(args.trace_out)
-        out["trace"] = {"path": args.trace_out, "spans": n,
-                        "dropped": tracer.dropped}
+        if args.trace_out:
+            n = tracer.export_chrome(args.trace_out)
+            out["trace"] = {"path": args.trace_out, "spans": n,
+                            "dropped": tracer.dropped}
         tracer.disable()
     if args.out:
         with open(args.out, "w") as f:
@@ -1423,19 +1464,25 @@ def _med_notnull(xs):
 
 
 def _run_obs_ab(args, serving_cfg) -> int:
-    """Tracing-overhead acceptance A/B: the same request set replayed with the
-    span tracer off vs on, arms interleaved over ONE engine (shared compile
-    cache — the A/B isolates tracing cost from compilation). Emits the
-    ``BENCH_OBS`` JSON with the <2% TPOT gate.
+    """Observability-overhead acceptance A/B: the same request set replayed
+    with (a) everything off, (b) the span tracer on, (c) the FULL diagnostic
+    stack on — tracer + flight recorder (attribution on every completion) +
+    anomaly detector — arms interleaved over ONE engine (shared compile cache
+    — the A/B isolates observability cost from compilation). Emits the
+    ``BENCH_OBS``/``BENCH_FLIGHT`` JSON with the <2% TPOT gates for BOTH the
+    tracing arm and the flight arm.
 
     The gated quantity is **aggregate TPOT under saturation**: arrivals are
     forced open-throttle so the scheduler is always busy and
     ``wall_s / tokens_total`` measures the pure per-token serving cost —
     per-request TPOT percentiles under open-loop arrivals carry queueing
     variance an order of magnitude above the 2% gate (they ride along in
-    ``detail``). Deltas are paired per rep and order-alternated so machine
-    drift cancels."""
+    ``detail``). Deltas are paired per rep (each arm against the same rep's
+    off run) and position-rotated so machine drift cancels."""
     from deepspeed_tpu.inference.serving import ContinuousBatchingScheduler
+    from deepspeed_tpu.observability import (AnomalyDetector, FlightRecorder,
+                                             get_registry)
+    from deepspeed_tpu.observability.anomaly import install_detector
     from deepspeed_tpu.observability.trace import get_tracer
     tracer = get_tracer()
     args.rate = max(args.rate, 1000.0)      # saturate: measure serving, not
@@ -1444,23 +1491,40 @@ def _run_obs_ab(args, serving_cfg) -> int:
     engine = build_engine(args)
     # warmup: pays every prefill-bucket + chunk compile, discarded
     run_load(ContinuousBatchingScheduler(engine, serving_cfg), args)
-    arms = {"off": [], "on": []}
+    arms = {"off": [], "on": [], "flight": []}
     span_counts = []
+    row_counts = []
+    breakdown = None
     for rep in range(max(1, args.obs_reps)):
-        # interleaved AND order-alternated (off,on / on,off / ...): the second
-        # run of a pair sees warmer allocator/cache state, which reads as a
-        # systematic arm bias unless the position is balanced
-        order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+        # interleaved AND position-rotated: the later runs of a round see
+        # warmer allocator/cache state, which reads as a systematic arm bias
+        # unless every arm takes every position across reps
+        base = ["off", "on", "flight"]
+        order = base[rep % 3:] + base[:rep % 3]
         for arm in order:
-            if arm == "on":
+            recorder = detector = None
+            if arm == "off":
+                tracer.disable()
+            else:
                 tracer.enable(pid_label="loadgen-ab")
                 tracer.reset()
-            else:
-                tracer.disable()
+            if arm == "flight":
+                # dump_path=None: retention/attribution run, nothing written
+                # — the arm measures the recorder, not file IO
+                recorder = FlightRecorder(dump_path=None).attach(tracer)
+                detector = AnomalyDetector(recorder=recorder)
+                install_detector(detector)
+                get_registry().attach_monitor(detector)
             snap = run_load(ContinuousBatchingScheduler(engine, serving_cfg),
                             args)
             if arm == "on":
                 span_counts.append(len(tracer.spans))
+            if arm == "flight":
+                row_counts.append(len(recorder.rows))
+                breakdown = recorder.breakdown()
+                get_registry().detach_monitor(detector)
+                install_detector(None)
+                recorder.detach()
             arms[arm].append(snap)
     tracer.disable()
 
@@ -1474,13 +1538,17 @@ def _run_obs_ab(args, serving_cfg) -> int:
         return (s["wall_s"] / s["tokens_total"] * 1e3
                 if s.get("tokens_total") else None)
 
-    # paired per-rep deltas (each on-rep against its adjacent off-rep over the
-    # identical request set), median across reps: slow machine drift hits
-    # both arms of a pair equally and cancels, unlike a cross-rep median
-    deltas = [(agg_ms_per_tok(b) - agg_ms_per_tok(a)) / agg_ms_per_tok(a)
-              for a, b in zip(arms["off"], arms["on"])
-              if agg_ms_per_tok(a) and agg_ms_per_tok(b)]
-    overhead = float(np.median(deltas)) if deltas else None
+    # paired per-rep deltas (each arm's rep against the SAME rep's off run
+    # over the identical request set), median across reps: slow machine drift
+    # hits every arm of a round equally and cancels, unlike a cross-rep median
+    def paired_overhead(arm):
+        deltas = [(agg_ms_per_tok(b) - agg_ms_per_tok(a)) / agg_ms_per_tok(a)
+                  for a, b in zip(arms["off"], arms[arm])
+                  if agg_ms_per_tok(a) and agg_ms_per_tok(b)]
+        return (float(np.median(deltas)) if deltas else None), deltas
+
+    overhead, deltas = paired_overhead("on")
+    flight_overhead, flight_deltas = paired_overhead("flight")
     out = {
         "metric": "obs_tracing_tpot_overhead_frac",
         "value": overhead, "unit": "frac", "smoke": bool(args.smoke),
@@ -1489,32 +1557,48 @@ def _run_obs_ab(args, serving_cfg) -> int:
                 agg_ms_per_tok(s) for s in arms["off"]),
             "agg_tpot_ms_per_token_on": _med_notnull(
                 agg_ms_per_tok(s) for s in arms["on"]),
+            "agg_tpot_ms_per_token_flight": _med_notnull(
+                agg_ms_per_tok(s) for s in arms["flight"]),
             "tpot_ms_p50_off": tpot_off,
             "tpot_ms_p50_on": tpot_on,
             "tpot_overhead_frac": overhead,
             "tpot_within_2pct": bool(overhead is not None
                                      and overhead <= 0.02),
+            # the PR 14 gate: attribution + flight recorder + anomaly
+            # detector all enabled still land within 2% of everything-off
+            "flight_overhead_frac": flight_overhead,
+            "flight_within_2pct": bool(flight_overhead is not None
+                                       and flight_overhead <= 0.02),
             "spans_per_on_rep": (float(np.median(span_counts))
                                  if span_counts else 0.0),
+            "attribution_rows_per_flight_rep": (
+                float(np.median(row_counts)) if row_counts else 0.0),
+            "attribution_breakdown_emitted": bool(
+                breakdown is not None and breakdown.get("requests", 0) > 0),
         },
         "detail": {
             "reps": args.obs_reps,
             "paired_tpot_deltas": deltas,     # per-pair noise, artifact-honest
+            "paired_flight_deltas": flight_deltas,
+            "attribution": breakdown,         # p50-vs-p99 phase shares
             "tokens_per_sec_off": med("off", "tokens_per_sec"),
             "tokens_per_sec_on": med("on", "tokens_per_sec"),
+            "tokens_per_sec_flight": med("flight", "tokens_per_sec"),
             "tpot_ms_mean_off": med("off", "tpot_ms_mean_exact"),
             "tpot_ms_mean_on": med("on", "tpot_ms_mean_exact"),
             "ttft_ms_p50_off": med("off", "ttft_ms_p50_exact"),
             "ttft_ms_p50_on": med("on", "ttft_ms_p50_exact"),
             "completed_off": sum(s["completed"] for s in arms["off"]),
             "completed_on": sum(s["completed"] for s in arms["on"]),
+            "completed_flight": sum(s["completed"] for s in arms["flight"]),
         },
     }
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
     print(json.dumps(out))
-    return 0 if out["obs_gates"]["tpot_within_2pct"] else 1
+    g = out["obs_gates"]
+    return 0 if g["tpot_within_2pct"] and g["flight_within_2pct"] else 1
 
 
 if __name__ == "__main__":

@@ -59,7 +59,8 @@ class BareAssertRule(AstRule):
 # ------------------------------------------------------------- emission tags
 _EMIT_FUNCS = {"write_events", "record_events", "record", "emit", "_write",
                "counter", "gauge", "histogram"}
-_TAG_RE = re.compile(r"^(serving|router|Train|inference)/[A-Za-z0-9_{}*./]+$")
+_TAG_RE = re.compile(r"^(serving|router|Train|inference|latency|flight"
+                     r"|anomaly)/[A-Za-z0-9_{}*./]+$")
 
 
 def _literal_tag(node: ast.AST) -> Optional[str]:

@@ -52,6 +52,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ...observability import flight as obs_flight
 from ...observability.metrics import RegistryFeed
 from ...observability.trace import CAT_AUTOSCALE, get_tracer
 from ...utils.logging import logger
@@ -325,6 +326,8 @@ class Autoscaler:
                                "replica": replica.id, **sig})
         self._tracer.end_span(span, attrs={"replica": replica.id,
                                            "target": self.target_replicas})
+        obs_flight.journal("scale_up", replica=replica.id,
+                           target=self.target_replicas, **sig)
         logger.info(f"[autoscale] scale UP -> replica {replica.id} "
                     f"(queue={sig['queue_depth']}, "
                     f"ttft_p95={sig['ttft_p95_ms']}, "
@@ -356,6 +359,8 @@ class Autoscaler:
         self.decisions.append({"t": now, "action": "down",
                                "replica": victim.id, **sig})
         self._tracer.end_span(span, attrs={"target": self.target_replicas})
+        obs_flight.journal("scale_down", replica=victim.id,
+                           target=self.target_replicas, **sig)
         logger.info(f"[autoscale] scale DOWN -> retiring replica {victim.id} "
                     f"(occupancy={sig['occupancy']:.2f}, "
                     f"active={sig['active_replicas']})")

@@ -64,6 +64,7 @@ from typing import Deque, Dict, List, Optional, Set
 
 import numpy as np
 
+from ...observability import flight as obs_flight
 from ...observability.metrics import Histogram, RegistryFeed
 from ...observability.trace import CAT_ROUTER, get_tracer
 from ...utils.fault_injection import fault_point, retry_with_backoff
@@ -586,8 +587,24 @@ class Router:
                       else 1.0)
             if est is not None and est > float(deadline_s) * margin:
                 self.telemetry.on_shed()
-                raise AdmissionShedError(self.retry_after_hint(now),
-                                         estimate_s=est,
+                hint = self.retry_after_hint(now)
+                # the shed decision leaves evidence: an instant request-root
+                # span (the flight recorder retains it with the estimate that
+                # refused the request) and a decision-journal entry
+                span = self._tracer.begin(
+                    "request", cat=CAT_ROUTER, t0=now, tid="router",
+                    attrs={"prompt_tokens": int(prompt.size), "state": "shed",
+                           "estimate_s": round(est, 4),
+                           "deadline_s": float(deadline_s),
+                           "retry_after": round(hint, 4),
+                           **({"session": session} if session is not None
+                              else {})})
+                self._tracer.end_span(span, t1=now)
+                obs_flight.journal("shed", estimate_s=round(est, 4),
+                                   deadline_s=float(deadline_s),
+                                   queue_depth=len(self.queue),
+                                   retry_after=round(hint, 4))
+                raise AdmissionShedError(hint, estimate_s=est,
                                          deadline_s=float(deadline_s))
         rr = RouterRequest(id=next(self._ids), prompt=prompt,
                            max_new_tokens=max_new, eos_token_id=eos_token_id,
@@ -661,6 +678,8 @@ class Router:
                                              "to": rung.name,
                                              "queue_fill": round(fill, 3)})
             self._tracer.end_span(span)
+            obs_flight.journal("degradation_rung", src=self._rung.name,
+                               dst=rung.name, queue_fill=round(fill, 3))
             self._rung = rung
         return rung
 
@@ -811,6 +830,11 @@ class Router:
         m = self.telemetry.monitor
         if m is not None and hasattr(m, "flush"):
             m.flush()
+        # ... and so must the flight evidence: every handed-off request's
+        # retained tree + the decision journal land in a drain bundle
+        obs_flight.journal("drain", handed_off=len(specs),
+                           drain_ms=round((now - t0) * 1e3, 2))
+        obs_flight.drain_dump()
         logger.info(f"[router] drain complete in {(now - t0) * 1e3:.1f} ms: "
                     f"{len(specs)} request(s) handed off")
         return specs
@@ -868,6 +892,8 @@ class Router:
             logger.info(f"[router] replica {replica_id}: {old.value} -> "
                         f"{new.value}")
             self.telemetry.on_transition(replica_id, old, new)
+            obs_flight.journal("replica_health", replica=replica_id,
+                               src=old.value, dst=new.value)
 
     def _mark_dead(self, replica, now: float, why: str) -> None:
         h = self.health[replica.id]

@@ -90,6 +90,114 @@ def _make_monitor(args) -> Optional[object]:
         "job_name": "deepspeed-serve"}))
 
 
+def make_status_provider(front, autoscaler=None, recorder=None,
+                         detector=None):
+    """``/statusz`` JSON assembler over a serving frontend (scheduler or
+    router): replica health + outstanding work, queue depth, degradation
+    rung, paged-KV pressure, prefix hit rate, recent anomaly trips, the last
+    autoscale decisions with their triggering signals, and the flight
+    recorder's retention stats."""
+    is_router = hasattr(front, "replicas")
+
+    def status():
+        doc = {"t": time.time(),
+               "kind": "router" if is_router else "scheduler"}
+        if is_router:
+            tel = front.telemetry
+            doc.update({
+                "queue_depth": front.queue_depth,
+                "draining": front.draining,
+                "degradation_rung": front.degradation_rung.value,
+                "degradation_rung_name": front.degradation_rung.name,
+                "replicas": [
+                    {"id": r.id,
+                     "health": front.health[r.id].state.value,
+                     "outstanding": r.outstanding,
+                     "running": r.running,
+                     "queued": r.queued,
+                     "retiring": front.health[r.id].retiring}
+                    for r in front.replicas],
+                "retired_replicas": list(front.retired),
+                "counters": {
+                    "submitted": tel.submitted, "completed": tel.completed,
+                    "retried": tel.retried, "evicted": tel.evicted,
+                    "rejected": tel.rejected, "shed": tel.shed,
+                    "deferred": tel.deferred, "expired": tel.expired,
+                    "handed_off": tel.handed_off},
+            })
+            pools = [r.scheduler.executor.pool for r in front.replicas]
+            paged = [p.stats() for p in pools if p.paged]
+            if paged:
+                doc["pages"] = {
+                    "pages_in_use": sum(p["pages_in_use"] for p in paged),
+                    "total_pages": sum(p["total_pages"] for p in paged),
+                    "page_fragmentation": (
+                        float(np.mean([p["page_fragmentation"]
+                                       for p in paged]))),
+                    "prefix_shared_pages": sum(p["prefix_shared_pages"]
+                                               for p in paged)}
+            if any(r.scheduler.prefix_cache is not None
+                   for r in front.replicas):
+                rep = front.prefix_cache_report()
+                doc["prefix_hit_rate"] = rep.get("hit_rate")
+        else:
+            tel = front.telemetry
+            pool = front.executor.pool
+            doc.update({
+                "queue_depth": front.queue_depth,
+                "slot_occupancy": pool.occupancy,
+                "counters": {"completed": tel.completed,
+                             "rejected": tel.rejected,
+                             "cancelled": tel.cancelled,
+                             "expired": tel.expired,
+                             "evicted": tel.evicted,
+                             "tokens_total": tel.tokens_total},
+            })
+            if pool.paged:
+                doc["pages"] = pool.stats()
+            if front.prefix_cache is not None:
+                doc["prefix_hit_rate"] = front.prefix_hit_rate
+        if autoscaler is not None:
+            doc["autoscale"] = {
+                "target_replicas": autoscaler.target_replicas,
+                "scale_ups": autoscaler.scale_ups,
+                "scale_downs": autoscaler.scale_downs,
+                "last_decisions": list(autoscaler.decisions)[-5:]}
+        if detector is not None:
+            doc["anomalies"] = {"trips": detector.trips,
+                                "recent": list(detector.recent)[-8:]}
+        if recorder is not None:
+            doc["flight"] = recorder.stats()
+        return doc
+
+    return status
+
+
+def make_health_provider(front):
+    """``/healthz`` liveness/readiness: the process answering IS liveness;
+    readiness = at least one LIVE replica AND the degradation ladder below
+    ADMISSION_CLOSED (a router that rejects every submission is alive but not
+    ready). The single-scheduler path is ready whenever it answers."""
+    is_router = hasattr(front, "replicas")
+
+    def health():
+        if not is_router:
+            return True, {"live": True, "ready": True, "kind": "scheduler"}
+        from .router import DegradationRung, ReplicaState
+        live = sum(1 for r in front.replicas
+                   if front.health[r.id].state == ReplicaState.LIVE)
+        rung = front.degradation_rung
+        ready = (live >= 1
+                 and rung.value < DegradationRung.ADMISSION_CLOSED.value
+                 and not front.draining)
+        return ready, {"live": True, "ready": ready, "kind": "router",
+                       "live_replicas": live,
+                       "degradation_rung": rung.value,
+                       "draining": front.draining}
+
+    return health
+
+
 def _result_line(h) -> str:
     return json.dumps({
         "id": h.id, "state": h.state.value, "finish_reason": h.finish_reason,
@@ -319,6 +427,12 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-out", default=None,
                     help="enable request-scoped tracing; write a "
                          "Perfetto-loadable Chrome trace here on exit")
+    ap.add_argument("--flight-out", default=None,
+                    help="enable the tail-latency flight recorder + anomaly "
+                         "detector (implies tracing); write the Perfetto-"
+                         "loadable flight bundle here on exit — SIGUSR1, "
+                         "router drain, and anomaly trips write numbered "
+                         "siblings (SIGUSR2 stays the XLA profiler)")
     ap.add_argument("--profile-dir", default=None,
                     help="arm on-demand XLA profiler capture to this logdir "
                          "(trigger with SIGUSR2)")
@@ -334,27 +448,60 @@ def main(argv=None) -> int:
     from ...utils.fault_injection import apply_fault_env
     apply_fault_env()
 
-    # observability spine: tracer / Prometheus exposition / profiler capture
-    from ...observability import (configure_capture, get_tracer,
+    # observability spine: tracer / flight recorder / Prometheus exposition /
+    # status plane / profiler capture
+    from ...observability import (AnomalyDetector, FlightRecorder,
+                                  configure_capture, get_registry, get_tracer,
                                   start_metrics_server)
+    from ...observability.anomaly import install_detector
     tracer = None
-    if args.trace_out:
+    if args.trace_out or args.flight_out:
         tracer = get_tracer().enable(pid_label="deepspeed-serve")
-        if args.trace_out.endswith(".jsonl"):
+        if args.trace_out and args.trace_out.endswith(".jsonl"):
             tracer.stream_to(args.trace_out)
+    recorder = detector = None
+    if args.flight_out:
+        recorder = FlightRecorder(dump_path=args.flight_out).attach(tracer)
+        recorder.install_sigusr1()          # SIGUSR2 stays the XLA profiler
+        detector = AnomalyDetector(recorder=recorder)
+        install_detector(detector)
+        get_registry().attach_monitor(detector)
     metrics_server = None
+    # the front doesn't exist yet when the port opens: the providers read a
+    # late-bound slot, and /healthz honestly reports not-ready until it lands
+    _providers = {"status": None, "health": None}
+
+    def _statusz():
+        fn = _providers["status"]
+        return fn() if fn is not None else {"starting": True}
+
+    def _healthz():
+        fn = _providers["health"]
+        if fn is None:
+            return False, {"live": True, "ready": False, "starting": True}
+        return fn()
+
     if args.metrics_port is not None:
-        metrics_server = start_metrics_server(args.metrics_port)
+        metrics_server = start_metrics_server(args.metrics_port,
+                                              status_provider=_statusz,
+                                              health_provider=_healthz)
         print(json.dumps({"metrics_port": metrics_server.server_port}),
               file=sys.stderr)
     if args.profile_dir:
         configure_capture(args.profile_dir, num_ticks=args.profile_steps)
 
     def _obs_epilogue():
-        # every exit path (selftest included) must land the trace the user
-        # asked for and release the exposition port
+        # every exit path (selftest included) must land the trace/bundle the
+        # user asked for and release the exposition port
+        if recorder is not None:
+            path = recorder.dump(args.flight_out, reason="exit")
+            print(json.dumps({"flight_out": path, **recorder.stats()}),
+                  file=sys.stderr)
+            get_registry().detach_monitor(detector)
+            install_detector(None)
+            recorder.detach()
         if tracer is not None:
-            if not args.trace_out.endswith(".jsonl"):
+            if args.trace_out and not args.trace_out.endswith(".jsonl"):
                 n = tracer.export_chrome(args.trace_out)
                 print(json.dumps({"trace_out": args.trace_out, "spans": n}),
                       file=sys.stderr)
@@ -385,6 +532,10 @@ def main(argv=None) -> int:
                                 kv_pool=args.kv_pool,
                                 kv_page_size=args.kv_page_size)
     monitor = _make_monitor(args)
+    if recorder is not None:
+        # mirror per-request attribution events into the monitor backend
+        # (telemetry already feeds both monitor and registry directly)
+        recorder.monitor = monitor
     chaos = None
     autoscaler = None
     # SLO admission lives on the Router: a bare --slo-admission must not
@@ -417,6 +568,10 @@ def main(argv=None) -> int:
                                 max_replicas=args.max_replicas))
         if args.chaos:
             chaos = ChaosSchedule(parse_chaos(args.chaos))
+        _providers["status"] = make_status_provider(
+            front, autoscaler=autoscaler, recorder=recorder,
+            detector=detector)
+        _providers["health"] = make_health_provider(front)
         if args.selftest:
             ok, snap = _selftest_router(front, engines, args.requests,
                                         args.vocab_size)
@@ -429,6 +584,9 @@ def main(argv=None) -> int:
         engine = _build_engine(args)
         front = ContinuousBatchingScheduler(engine, serving_cfg,
                                             monitor=monitor)
+        _providers["status"] = make_status_provider(front, recorder=recorder,
+                                                    detector=detector)
+        _providers["health"] = make_health_provider(front)
         if args.selftest:
             ok, snap = _selftest(front, args.requests, args.vocab_size)
             print(json.dumps({"selftest_ok": ok, **snap}))

@@ -179,6 +179,9 @@ class SubprocessReplica:
             stderr=subprocess.DEVNULL)
         self.ready: Optional[Dict] = None
         self.progress: Dict[int, Dict] = {}      # id -> last streamed line
+        # traced submissions: id -> (trace_id, parent_span, t_submit) — what
+        # abandon_open_lanes needs to force-close a killed child's lanes
+        self._trace_ctx: Dict[int, tuple] = {}
         # child-side finished spans: bounded drop-oldest, same contract as
         # the tracer's own ring — a traced soak must not grow a Python list
         # forever on the parent
@@ -207,7 +210,13 @@ class SubprocessReplica:
                         self.spans_dropped += overflow
                     self.spans.extend(obj["spans"])
                 elif "id" in obj:
-                    self.progress[int(obj["id"])] = obj
+                    rid = int(obj["id"])
+                    self.progress[rid] = obj
+                    if obj.get("done"):
+                        # completed lanes need no abandon context: without
+                        # this, _trace_ctx grows one entry per traced
+                        # request for the life of the replica
+                        self._trace_ctx.pop(rid, None)
 
     def wait_ready(self, timeout: float = 120.0) -> Dict:
         t0 = time.monotonic()
@@ -230,8 +239,42 @@ class SubprocessReplica:
         if trace_id:
             req["trace_id"] = trace_id
             req["parent_span"] = parent_span
+            self._trace_ctx[int(rid)] = (trace_id, parent_span,
+                                         time.monotonic())
         self.proc.stdin.write(json.dumps(req) + "\n")
         self.proc.stdin.flush()
+
+    def abandon_open_lanes(self, tracer) -> List[int]:
+        """Force-close a killed child's in-flight request lanes.
+
+        The child's ``replica_request`` spans were still OPEN when the SIGKILL
+        landed — they never committed, so the dead lane would be a hole in the
+        trace. The parent knows the span context it handed each request, so it
+        commits one ``state=abandoned`` ``replica_request`` span per undone
+        traced request — the same force-close the in-process router performs
+        at absorb time — and the flight recorder's retention/attribution see
+        the abandoned lane joined to the retry attempt by trace id. Returns
+        the request ids closed."""
+        from ...observability.trace import SpanContext
+        now = time.monotonic()
+        closed = []
+        for rid, (tid, pspan, t0) in list(self._trace_ctx.items()):
+            # every entry is consumed: done lanes need no closing span, and a
+            # second abandon call must not re-emit spans for lanes this one
+            # already force-closed (pop, not del: the reader thread prunes
+            # done lanes concurrently)
+            self._trace_ctx.pop(rid, None)
+            if self.done(rid):
+                continue
+            ctx = SpanContext(str(tid), str(pspan or ""))
+            tracer.record_span(
+                "replica_request", ctx, t0, now,
+                attrs={"state": "abandoned", "reason": "sigkill",
+                       "request_id": rid,
+                       "tokens_streamed": len(self.tokens(rid))},
+                tid="subproc-abandoned")
+            closed.append(rid)
+        return closed
 
     def take_spans(self) -> List[Dict]:
         """Child-side spans streamed so far (drained); ingest into the parent

@@ -1,0 +1,190 @@
+"""Anomaly-triggered diagnostics: EWMA+MAD scoring over registry streams.
+
+Out-of-family behavior should capture its own evidence: by the time a human
+reads the dashboard, the stalled chunk and the queue spike that caused the
+page are long gone. The detector watches a small set of registry streams
+(TTFT / TPOT / queue depth / page fragmentation / retry rate), scores each
+observation with a robust z — ``|x - EWMA| / (1.4826 * MAD_EWMA + floor)``,
+where the MAD term is an EWMA of absolute deviations (median-free so it stays
+O(1)) — and, when a score clears ``threshold`` after warm-up, **trips**:
+
+- the trip (signal name, value, EWMA, MAD, score, threshold) is recorded and
+  journaled into the flight recorder's decision journal;
+- the flight recorder dumps a bundle (so the anomalous window's retained span
+  trees, metrics snapshots, and coincident control-plane decisions land in
+  one Perfetto-loadable file);
+- the PR 10 XLA profiler capture is **armed for the next K ticks** (if one is
+  configured) — the out-of-family decode chunks self-capture their device
+  profile, no human in the loop.
+
+Trips are rate-limited (``cooldown_s``): a sustained incident produces one
+bundle, not a bundle per request. Counter-kind streams (``*_total``) are
+scored on their per-event **delta** — a retry *rate* spike trips, a large
+cumulative total does not.
+
+The detector implements the registry's monitor interface (``enabled`` +
+``write_events``), so ``registry.attach_monitor(detector)`` taps every
+emission without touching the emitters.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from collections import deque
+
+from . import schema
+from .metrics import record_events
+from ..utils.logging import logger
+
+#: default watched streams: the issue-level tail signals. Histogram tags are
+#: per-event observations; gauge tags are per-tick samples; counter tags are
+#: scored on deltas (rates).
+DEFAULT_WATCH = (
+    "serving/ttft_ms", "serving/tpot_ms",
+    "router/ttft_ms", "router/tpot_ms",
+    "serving/queue_depth", "router/queue_depth",
+    "serving/page_fragmentation",
+    "router/retried_total",
+)
+
+
+@dataclass
+class AnomalyConfig:
+    threshold: float = 8.0        # robust-z trip bar
+    alpha: float = 0.05           # EWMA weight (mean and MAD)
+    min_obs: int = 16             # per-signal warm-up before scoring
+    cooldown_s: float = 5.0       # global trip rate limit
+    arm_profiler_ticks: int = 8   # XLA capture length on trip
+    # robust-z floor: MAD of a quiet signal (queue depth pinned at 0) is ~0,
+    # and a bare 1/MAD would trip on the first nonzero sample; the floor is
+    # relative to the signal's own scale plus a small absolute term
+    rel_floor: float = 0.05
+    abs_floor: float = 1e-3
+    watch: Tuple[str, ...] = DEFAULT_WATCH
+
+
+@dataclass
+class _SignalState:
+    ewma: Optional[float] = None
+    mad: float = 0.0
+    n: int = 0
+    last_total: Optional[float] = None   # counter kinds: delta base
+
+
+class AnomalyDetector:
+    """Attach with ``get_registry().attach_monitor(detector)``."""
+
+    enabled = True                # monitor-interface gate the registry checks
+
+    def __init__(self, config: Optional[AnomalyConfig] = None,
+                 recorder=None):
+        self.config = config or AnomalyConfig()
+        self.recorder = recorder
+        self._watch = set(self.config.watch)
+        self._state: Dict[str, _SignalState] = {}
+        self._counter_kind: Dict[str, bool] = {}
+        self.trips = 0
+        self.suppressed = 0           # would-trip events inside the cooldown
+        self.recent: deque = deque(maxlen=64)
+        self._last_trip: Optional[float] = None
+
+    # ---------------------------------------------------------------- monitor
+    def write_events(self, events) -> None:
+        for tag, value, step in events:
+            if tag in self._watch:
+                self.observe(tag, float(value))
+
+    # ---------------------------------------------------------------- scoring
+    def _is_counter(self, tag: str) -> bool:
+        kind = self._counter_kind.get(tag)
+        if kind is None:
+            kind = schema.kind_of(tag) == schema.COUNTER
+            self._counter_kind[tag] = kind
+        return kind
+
+    def observe(self, tag: str, value: float,
+                now: Optional[float] = None) -> Optional[Dict]:
+        """Score one observation; returns the trip record when it trips."""
+        cfg = self.config
+        st = self._state.get(tag)
+        if st is None:
+            st = self._state[tag] = _SignalState()
+        if self._is_counter(tag):
+            if st.last_total is None:
+                st.last_total = value
+                return None
+            value, st.last_total = max(0.0, value - st.last_total), value
+        trip = None
+        if st.ewma is not None and st.n >= cfg.min_obs:
+            dev = abs(value - st.ewma)
+            denom = (1.4826 * st.mad + cfg.rel_floor * abs(st.ewma)
+                     + cfg.abs_floor)
+            score = dev / denom
+            if score > cfg.threshold:
+                trip = self._trip(tag, value, st, score, now)
+        # update AFTER scoring: the sample is judged against the family it
+        # arrived into, and a huge outlier must not normalize itself
+        a = cfg.alpha
+        if st.ewma is None:
+            st.ewma = value
+        else:
+            st.mad = (1 - a) * st.mad + a * abs(value - st.ewma)
+            st.ewma = (1 - a) * st.ewma + a * value
+        st.n += 1
+        return trip
+
+    def _trip(self, tag: str, value: float, st: _SignalState, score: float,
+              now: Optional[float]) -> Optional[Dict]:
+        cfg = self.config
+        now = time.monotonic() if now is None else now
+        if self._last_trip is not None \
+                and now - self._last_trip < cfg.cooldown_s:
+            self.suppressed += 1
+            return None
+        self._last_trip = now
+        self.trips += 1
+        record = {"t": time.time(), "signal": tag, "value": value,
+                  "ewma": st.ewma, "mad": st.mad, "score": score,
+                  "threshold": cfg.threshold}
+        self.recent.append(record)
+        logger.warning(f"[anomaly] {tag} out of family: value={value:.4g} "
+                       f"ewma={st.ewma:.4g} score={score:.1f} "
+                       f"(threshold {cfg.threshold})")
+        rec = self.recorder
+        if rec is None:
+            from .flight import get_recorder
+            rec = get_recorder()
+        if rec is not None:
+            rec.journal("anomaly", dict(record))
+            # the trip carries its own evidence list: the dump must name the
+            # triggering signal even when this detector isn't the installed one
+            rec.dump_auto(f"anomaly:{tag}", anomalies=list(self.recent))
+        # arm the PR 10 device-profiler capture: the next K decode chunks /
+        # prefills / train steps self-capture their XLA timeline
+        from .profiler import get_capture
+        cap = get_capture()
+        if cap is not None:
+            cap.arm(cfg.arm_profiler_ticks)
+        record_events([("anomaly/trips_total", float(self.trips), self.trips),
+                       ("anomaly/last_score", float(score), self.trips)])
+        return record
+
+    def snapshot(self) -> Dict:
+        return {"trips": self.trips, "suppressed": self.suppressed,
+                "recent": list(self.recent),
+                "signals": {tag: {"ewma": st.ewma, "mad": st.mad, "n": st.n}
+                            for tag, st in self._state.items()}}
+
+
+# ------------------------------------------------------- process-wide detector
+_detector: Optional[AnomalyDetector] = None
+
+
+def install_detector(det: Optional[AnomalyDetector]) -> None:
+    global _detector
+    _detector = det
+
+
+def get_detector() -> Optional[AnomalyDetector]:
+    return _detector

@@ -1,0 +1,396 @@
+"""Tail-latency flight recorder: bounded tail sampling + decision journal.
+
+Aggregate histograms say *that* p99 blew up; they cannot say *why*, because by
+the time the dashboard shows the spike the offending requests' evidence is
+gone. The flight recorder closes that loop: it rides the tracer's span stream
+(:meth:`~.trace.Tracer.add_sink`), attributes every completed request
+(:mod:`.attribution` — phase histograms into the registry), and retains **full
+span trees** for exactly the requests worth a post-mortem:
+
+- **slow** — e2e latency above ``slow_p95_mult`` × an EWMA-smoothed p95 of
+  recent e2e (adaptive: the bar follows the workload, so a uniformly slow
+  soak doesn't retain everything and a fast one doesn't retain nothing);
+- **failed / expired / shed / handed-off / cancelled-by-error** — any root
+  state other than ``finished``;
+- **retried / evicted** — the root records retries, or any lane in the tree
+  closed ``state=abandoned``/``evicted`` (a killed replica's force-closed
+  lane rides along with the retry that recovered it);
+- a **1-in-N uniform sample** of healthy requests (the baseline to diff the
+  anomalies against).
+
+Everything else keeps only its attribution row (bounded). Retention is doubly
+bounded — max retained traces AND max total retained spans — with drop-oldest
+eviction, counted, never silent.
+
+The recorder also keeps a structured **control-plane decision journal**: the
+router's degradation-rung and replica-health transitions, admission sheds,
+autoscale decisions, and anomaly trips append ``{"t", "kind", ...}`` entries
+through the module-level :func:`journal` hook (one global load + None check
+when no recorder is installed — hot-path safe). A :meth:`FlightRecorder.dump`
+bundle is a **Perfetto-loadable** Chrome trace of the retained trees whose
+``otherData`` carries the journal, rolling registry snapshots, recent anomaly
+trips, and the p50-vs-p99 phase breakdown — triggered on demand, by
+``SIGUSR1`` (``SIGUSR2`` stays the PR 10 XLA profiler), at router drain, and
+by the anomaly detector.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from . import attribution
+from .metrics import record_events
+from .trace import chrome_events_from
+from ..utils.logging import logger
+
+
+@dataclass
+class FlightConfig:
+    slow_p95_mult: float = 3.0        # slow = e2e > mult * EWMA p95
+    warmup_requests: int = 20         # no slow-retention before this many rows
+    sample_every: int = 50            # uniform 1-in-N healthy sample
+    p95_window: int = 256             # recent e2e window the p95 reads
+    p95_alpha: float = 0.2            # EWMA smoothing of the windowed p95
+    p95_refresh: int = 16             # completions between p95 recomputes
+    #   (a per-completion percentile over the window is pure overhead — the
+    #   EWMA bar moves slowly by design)
+    max_open_traces: int = 512        # in-flight trace buffers (drop-oldest)
+    max_spans_per_trace: int = 2048
+    max_retained_traces: int = 64     # full-tree retention budget ...
+    max_retained_spans: int = 20000   # ... and the global span budget
+    rows: int = 4096                  # attribution rows kept (bounded)
+    journal_len: int = 512
+    snapshots: int = 16               # rolling registry snapshots in the dump
+    snapshot_every_s: float = 2.0
+
+
+class FlightRecorder:
+    """Span-sink tail sampler over a :class:`~.trace.Tracer`.
+
+    ``dump_path`` is the default bundle destination; automatic dumps (SIGUSR1,
+    drain, anomaly trips) write numbered siblings next to it. ``dump_path=
+    None`` disables automatic dumps (attribution/retention still run) —
+    the overhead A/B uses that mode."""
+
+    def __init__(self, config: Optional[FlightConfig] = None,
+                 dump_path: Optional[str] = None, registry=None,
+                 monitor=None):
+        self.config = config or FlightConfig()
+        self.dump_path = dump_path
+        self._registry = registry
+        # optional MonitorMaster-shaped backend: attribution events mirror
+        # into it (loadgen --jsonl-metrics gains per-request phase rows)
+        # WITHOUT attaching the monitor to the registry, which would
+        # double-write every telemetry tag (telemetry already feeds both)
+        self.monitor = monitor
+        self._lock = threading.Lock()
+        self._open: "OrderedDict[str, List[Dict]]" = OrderedDict()
+        self.rows: deque = deque(maxlen=self.config.rows)
+        self.retained: deque = deque()
+        self.retained_spans = 0
+        self.retained_evicted = 0
+        self.open_dropped = 0         # in-flight trace buffers evicted
+        self.span_drops = 0           # spans over the per-trace bound
+        self.completions = 0
+        self.dumps = 0
+        self._journal: deque = deque(maxlen=self.config.journal_len)
+        self._snapshots: deque = deque(maxlen=self.config.snapshots)
+        self._last_snapshot = 0.0
+        self._e2e_window: deque = deque(maxlen=self.config.p95_window)
+        self._p95_ewma: Optional[float] = None
+        self._since_p95 = 0
+        self._dump_requested = False
+        self._tracer = None
+        self._prev_usr1 = None
+
+    # ----------------------------------------------------------------- attach
+    def attach(self, tracer) -> "FlightRecorder":
+        """Sink onto ``tracer`` and install as THE process recorder (the
+        module-level :func:`journal` hook routes here)."""
+        self._tracer = tracer
+        tracer.add_sink(self.on_span)
+        install_recorder(self)
+        return self
+
+    def detach(self) -> None:
+        if self._tracer is not None:
+            self._tracer.remove_sink(self.on_span)
+            self._tracer = None
+        if get_recorder() is self:
+            install_recorder(None)
+
+    def install_sigusr1(self):
+        """Route ``SIGUSR1`` to :meth:`request_dump` (flag only — the next
+        span commit performs the dump; a serving loop commits spans
+        constantly). Returns the previous handler."""
+        def _handler(signum, frame):
+            self.request_dump()
+        self._prev_usr1 = signal.signal(signal.SIGUSR1, _handler)
+        return self._prev_usr1
+
+    def request_dump(self) -> None:
+        """Signal-handler safe: flag only."""
+        self._dump_requested = True
+
+    # ------------------------------------------------------------------- sink
+    def on_span(self, span: Dict) -> None:
+        """Tracer sink: buffer by trace id; a parentless span completes its
+        trace (request roots commit last — the scheduler/router end them at
+        finalize)."""
+        tid = span.get("trace_id")
+        if tid is None:
+            return
+        done = None
+        with self._lock:
+            buf = self._open.get(tid)
+            if buf is None:
+                while len(self._open) >= self.config.max_open_traces:
+                    self._open.popitem(last=False)
+                    self.open_dropped += 1
+                buf = self._open[tid] = []
+            if len(buf) < self.config.max_spans_per_trace:
+                buf.append(span)
+            else:
+                self.span_drops += 1
+            if not span.get("parent_id"):
+                done = self._open.pop(tid, None)
+        if done is not None and span.get("name") in attribution.ROOT_NAMES:
+            self._finalize_trace(tid, done)
+        self._housekeeping()
+
+    def _housekeeping(self) -> None:
+        if self._dump_requested:
+            self._dump_requested = False
+            self.dump_auto("sigusr1")
+        if self.dump_path is None:
+            return          # snapshots exist only to ride dump bundles
+        now = time.monotonic()
+        if now - self._last_snapshot >= self.config.snapshot_every_s:
+            self._last_snapshot = now
+            self._snapshots.append({"t": time.time(),
+                                    "metrics": self._reg().snapshot()})
+
+    def _reg(self):
+        if self._registry is None:
+            from .metrics import get_registry
+            self._registry = get_registry()
+        return self._registry
+
+    # ------------------------------------------------------------- attribution
+    def _finalize_trace(self, tid: str, spans: List[Dict]) -> None:
+        row = attribution.attribute(spans)
+        if row is None:
+            return
+        cfg = self.config
+        with self._lock:
+            self.completions += 1
+            idx = self.completions
+            self.rows.append(row)
+            slow_bar = (cfg.slow_p95_mult * self._p95_ewma
+                        if self._p95_ewma is not None
+                        and len(self._e2e_window) >= min(cfg.warmup_requests,
+                                                         cfg.p95_window)
+                        else None)
+            reason = self._keep_reason(row, spans, slow_bar, idx)
+            # the bar updates AFTER the decision: a request is judged against
+            # the distribution that existed when it ran. Recomputing the
+            # window percentile is amortized over p95_refresh completions —
+            # the EWMA bar moves slowly by design, and a per-completion
+            # percentile was the recorder's single biggest hot-path cost.
+            # Only FINISHED requests define the family: instant shed roots
+            # (e2e≈0) and expired/failed tails would drag the windowed p95
+            # toward 0 during an incident, collapsing the slow bar and
+            # mass-retaining healthy traffic as "slow".
+            state = row.get("state")
+            if row["e2e_ms"] > 0.0 and (state is None or state == "finished"):
+                self._e2e_window.append(row["e2e_ms"])
+                self._since_p95 += 1
+            if self._e2e_window \
+                    and (self._p95_ewma is None
+                         or self._since_p95 >= cfg.p95_refresh):
+                self._since_p95 = 0
+                xs = sorted(self._e2e_window)
+                p95_now = xs[int(0.95 * (len(xs) - 1))]
+                a = cfg.p95_alpha
+                self._p95_ewma = (p95_now if self._p95_ewma is None
+                                  else (1 - a) * self._p95_ewma + a * p95_now)
+            if reason is not None:
+                self.retained.append({"trace_id": tid, "reason": reason,
+                                      "t": time.time(), "spans": spans,
+                                      "attribution": row})
+                self.retained_spans += len(spans)
+                while (len(self.retained) > cfg.max_retained_traces
+                       or self.retained_spans > cfg.max_retained_spans):
+                    gone = self.retained.popleft()
+                    self.retained_spans -= len(gone["spans"])
+                    self.retained_evicted += 1
+            n_traces, n_spans = len(self.retained), self.retained_spans
+        # only phases that HAPPENED are observed: zero rows would flood every
+        # histogram's underflow bucket and double the per-completion emission
+        # cost; "queue time when there was queueing" is the useful quantile
+        # (instant shed roots contribute no latency observation at all)
+        events = ([(attribution.E2E_TAG, row["e2e_ms"], idx)]
+                  if row["e2e_ms"] > 0.0 else [])
+        for phase, ms in row["phases"].items():
+            if ms > 0.0:
+                events.append((attribution.PHASE_TAGS[phase], ms, idx))
+        events.append(("flight/retained_traces", float(n_traces), idx))
+        events.append(("flight/retained_spans", float(n_spans), idx))
+        record_events(events)
+        if self.monitor is not None and getattr(self.monitor, "enabled",
+                                                False):
+            self.monitor.write_events(events)
+
+    def _keep_reason(self, row: Dict, spans: List[Dict],
+                     slow_bar: Optional[float], idx: int) -> Optional[str]:
+        state = row.get("state")
+        if state is not None and state != "finished":
+            return state                      # failed/expired/shed/handed_off
+        if (row.get("retried") or 0) > 0 or (row.get("attempts") or 1) > 1:
+            return "retried"
+        if row.get("failed_lanes"):       # attribution already walked the
+            return "evicted"              # tree — no second span scan here
+        if slow_bar is not None and row["e2e_ms"] > slow_bar:
+            return "slow"
+        if self.config.sample_every and idx % self.config.sample_every == 0:
+            return "sample"
+        return None
+
+    # ---------------------------------------------------------------- journal
+    def journal(self, kind: str, attrs: Optional[Dict] = None) -> None:
+        entry = {"t": time.time(), "kind": str(kind)}
+        if attrs:
+            entry.update(attrs)
+        self._journal.append(entry)
+
+    def journal_entries(self) -> List[Dict]:
+        return list(self._journal)
+
+    # ------------------------------------------------------------------- dump
+    def breakdown(self) -> Dict:
+        """The p50-vs-p99 phase-share breakdown over the attribution rows."""
+        with self._lock:
+            rows = list(self.rows)
+        return attribution.phase_breakdown(rows)
+
+    def stats(self) -> Dict:
+        """Status-plane summary (``/statusz``)."""
+        with self._lock:
+            reasons: Dict[str, int] = {}
+            for r in self.retained:
+                reasons[r["reason"]] = reasons.get(r["reason"], 0) + 1
+            return {"completions": self.completions,
+                    "rows": len(self.rows),
+                    "retained_traces": len(self.retained),
+                    "retained_spans": self.retained_spans,
+                    "retained_evicted": self.retained_evicted,
+                    "retained_reasons": reasons,
+                    "open_traces": len(self._open),
+                    "open_dropped": self.open_dropped,
+                    "span_drops": self.span_drops,
+                    "dumps": self.dumps,
+                    "slow_bar_ms": (self.config.slow_p95_mult * self._p95_ewma
+                                    if self._p95_ewma is not None else None)}
+
+    def dump(self, path: Optional[str] = None, reason: str = "manual",
+             anomalies: Optional[List[Dict]] = None) -> Optional[str]:
+        """Write the Perfetto-loadable bundle: retained span trees as Chrome
+        trace events, with the journal / rolling metrics snapshots / anomaly
+        trips / phase breakdown under ``otherData``. Returns the path (None
+        when no destination is configured)."""
+        path = path or self.dump_path
+        if path is None:
+            return None
+        with self._lock:
+            retained = list(self.retained)
+            journal_ = list(self._journal)
+            snapshots = list(self._snapshots)
+            stats = {"retained_evicted": self.retained_evicted,
+                     "open_dropped": self.open_dropped,
+                     "span_drops": self.span_drops,
+                     "completions": self.completions}
+        spans: List[Dict] = []
+        for r in retained:
+            spans.extend(r["spans"])
+        bundle = {
+            "traceEvents": chrome_events_from(spans),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "kind": "flight_bundle",
+                "reason": reason,
+                "t": time.time(),
+                "retained": [{"trace_id": r["trace_id"],
+                              "reason": r["reason"], "t": r["t"],
+                              "spans": len(r["spans"]),
+                              "attribution": r["attribution"]}
+                             for r in retained],
+                "breakdown": attribution.phase_breakdown(
+                    [r["attribution"] for r in retained] or list(self.rows)),
+                "journal": journal_,
+                "metrics_snapshots": snapshots
+                + [{"t": time.time(), "metrics": self._reg().snapshot()}],
+                "anomalies": anomalies if anomalies is not None
+                else _recent_anomalies(),
+                "drops": stats,
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(bundle, f)
+        self.dumps += 1
+        record_events([("flight/dumps_total", float(self.dumps), self.dumps)])
+        logger.info(f"[flight] bundle ({reason}) -> {path}: "
+                    f"{len(retained)} trace(s), {len(spans)} span(s)")
+        return path
+
+    def dump_auto(self, reason: str,
+                  anomalies: Optional[List[Dict]] = None) -> Optional[str]:
+        """Numbered sibling of ``dump_path`` for automatic triggers (SIGUSR1,
+        drain, anomaly) — the final/explicit bundle is never clobbered."""
+        if self.dump_path is None:
+            return None
+        stem, ext = os.path.splitext(self.dump_path)
+        return self.dump(f"{stem}.auto{self.dumps}{ext or '.json'}",
+                         reason=reason, anomalies=anomalies)
+
+
+# ------------------------------------------------------- process-wide recorder
+_recorder: Optional[FlightRecorder] = None
+
+
+def install_recorder(rec: Optional[FlightRecorder]) -> None:
+    global _recorder
+    _recorder = rec
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def journal(kind: str, **attrs) -> None:
+    """Hot-path decision-journal hook: one global load + None check when no
+    recorder is installed. Control-plane sites (router rung/health
+    transitions, sheds, autoscale decisions, anomaly trips) call this."""
+    r = _recorder
+    if r is not None:
+        r.journal(kind, attrs)
+
+
+def drain_dump() -> Optional[str]:
+    """Router drain epilogue: dump the bundle if a recorder is installed."""
+    r = _recorder
+    if r is not None:
+        return r.dump_auto("router_drain")
+    return None
+
+
+def _recent_anomalies() -> List[Dict]:
+    """Recent trips from the installed anomaly detector (if any) — lazy
+    import; anomaly.py imports this module, not vice versa."""
+    from .anomaly import get_detector
+    det = get_detector()
+    return list(det.recent) if det is not None else []

@@ -323,27 +323,69 @@ def record_events(events: Iterable[Event]) -> None:
 
 # --------------------------------------------------------------- /metrics HTTP
 def start_metrics_server(port: int, registry: Optional[MetricsRegistry] = None,
-                         host: str = "127.0.0.1"):
-    """Serve ``GET /metrics`` (Prometheus text) on a daemon thread. Returns the
-    ``http.server`` instance — ``server_port`` holds the bound port (pass
-    ``port=0`` for an ephemeral one), ``shutdown()`` stops it."""
+                         host: str = "127.0.0.1", status_provider=None,
+                         health_provider=None):
+    """Serve the observability HTTP plane on a daemon thread:
+
+    - ``GET /metrics`` — Prometheus text exposition from ``registry``;
+    - ``GET /statusz`` — live status JSON from ``status_provider()`` (replica
+      health, outstanding work, pages, prefix hit rate, degradation rung,
+      recent anomalies, last autoscale decisions — whatever the provider
+      assembles); 404 when no provider is wired;
+    - ``GET /healthz`` — liveness/readiness: ``health_provider()`` returns
+      ``(ready, payload)``; the response is the payload JSON with status 200
+      when ready, 503 when not. Without a provider the process being able to
+      answer IS the liveness check: 200 ``{"live": true, "ready": true}``.
+
+    Returns the ``http.server`` instance — ``server_port`` holds the bound
+    port (pass ``port=0`` for an ephemeral one), ``shutdown()`` stops it."""
+    import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry or _registry
 
     class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):
-            if self.path.split("?")[0].rstrip("/") not in ("", "/metrics"):
-                self.send_response(404)
-                self.end_headers()
-                return
-            body = reg.prometheus_text().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.split("?")[0].rstrip("/")
+            if path in ("", "/metrics"):
+                self._send(200, reg.prometheus_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+                return
+            if path == "/statusz":
+                if status_provider is None:
+                    self._send(404, b"no status provider wired\n",
+                               "text/plain")
+                    return
+                try:
+                    doc = status_provider()
+                except Exception as e:   # a broken provider must not 500-loop
+                    doc = {"error": f"{type(e).__name__}: {e}"}
+                self._send(200, (_json.dumps(doc) + "\n").encode(),
+                           "application/json")
+                return
+            if path == "/healthz":
+                if health_provider is None:
+                    ready, doc = True, {"live": True, "ready": True}
+                else:
+                    try:
+                        ready, doc = health_provider()
+                    except Exception as e:
+                        ready, doc = False, {"live": True, "ready": False,
+                                             "error":
+                                             f"{type(e).__name__}: {e}"}
+                self._send(200 if ready else 503,
+                           (_json.dumps(doc) + "\n").encode(),
+                           "application/json")
+                return
+            self.send_response(404)
+            self.end_headers()
 
         def log_message(self, *args):     # stay quiet on the serving stdout
             pass

@@ -85,6 +85,27 @@ TAGS: Dict[str, Tuple[str, str]] = {
     "Train/tokens_per_sec": (GAUGE, "global batch tokens / step time"),
     "Train/mfu": (GAUGE, "modeled model-flops utilization "
                          "(profiled flops / step time / peak)"),
+    # ------------------------------------------ latency attribution (PR 14)
+    "latency/e2e_ms": (HISTOGRAM, "end-to-end request latency (root span)"),
+    "latency/phase/queue_ms": (HISTOGRAM, "admission-queue wait per request"),
+    "latency/phase/admission_ms": (HISTOGRAM, "admission work (prefix "
+                                              "lookup) per request"),
+    "latency/phase/kv_restore_ms": (HISTOGRAM, "prefix-slab restore / page "
+                                               "bind per request"),
+    "latency/phase/prefill_ms": (HISTOGRAM, "prefill compute per request"),
+    "latency/phase/decode_ms": (HISTOGRAM, "decode-chunk compute per request"),
+    "latency/phase/gap_ms": (HISTOGRAM, "inter-chunk scheduling gap per "
+                                        "request"),
+    "latency/phase/retry_lost_ms": (HISTOGRAM, "time lost to abandoned lanes "
+                                               "(evicted attempts) per "
+                                               "request"),
+    # ------------------------------------------- flight recorder (PR 14)
+    "flight/retained_traces": (GAUGE, "span trees retained by tail sampling"),
+    "flight/retained_spans": (GAUGE, "total spans across retained trees"),
+    "flight/dumps_total": (COUNTER, "flight bundles written"),
+    # ------------------------------------------- anomaly detector (PR 14)
+    "anomaly/trips_total": (COUNTER, "anomaly-detector trips (rate-limited)"),
+    "anomaly/last_score": (GAUGE, "robust-z score of the last trip"),
     # --------------------------------------------------------------- inference
     "inference/ttft_ms": (HISTOGRAM, "prefill latency per generate call"),
     "inference/tpot_ms": (HISTOGRAM, "decode seconds-per-token per generate"),
@@ -152,6 +173,9 @@ EMITTER_MODULES = (
     "deepspeed_tpu/runtime/engine.py",
     "deepspeed_tpu/inference/engine.py",
     "deepspeed_tpu/observability/metrics.py",
+    "deepspeed_tpu/observability/attribution.py",
+    "deepspeed_tpu/observability/flight.py",
+    "deepspeed_tpu/observability/anomaly.py",
 )
 
 

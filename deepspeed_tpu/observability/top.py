@@ -1,0 +1,135 @@
+"""``ds-tpu-top``: a small polling terminal view over ``/statusz``.
+
+The live status plane (``observability.metrics.start_metrics_server`` +
+``inference/serving/server.make_status_provider``) publishes one JSON
+document; this renders it as a refreshing terminal frame — replica health and
+outstanding work, the degradation rung, paged-KV pressure, prefix hit rate,
+the last autoscale decisions, recent anomaly trips, and the flight recorder's
+retention stats. ``--once`` prints a single frame (scripts/tests);
+otherwise the frame redraws every ``--interval`` seconds until interrupted.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def fetch_status(host: str, port: int, timeout: float = 5.0) -> Dict:
+    url = f"http://{host}:{port}/statusz"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+_RUNG_NAMES = {0: "HEALTHY", 1: "DEFER_LOW", 2: "SHED_INFEASIBLE",
+               3: "ADMISSION_CLOSED"}
+
+
+def _fmt(v, nd: int = 2) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render(doc: Dict) -> str:
+    """One status frame as a multi-line string."""
+    lines: List[str] = []
+    if doc.get("starting"):
+        return "ds-tpu-top: server starting (no frontend yet)\n"
+    kind = doc.get("kind", "?")
+    rung = doc.get("degradation_rung")
+    head = [f"ds-tpu-top  [{kind}]",
+            time.strftime("%H:%M:%S", time.localtime(doc.get("t",
+                                                             time.time())))]
+    if rung is not None:
+        head.append(f"rung={doc.get('degradation_rung_name', _RUNG_NAMES.get(rung, rung))}")
+    if doc.get("draining"):
+        head.append("DRAINING")
+    lines.append("  ".join(head))
+    lines.append(f"queue={_fmt(doc.get('queue_depth'))}"
+                 + (f"  occupancy={_fmt(doc.get('slot_occupancy'))}"
+                    if "slot_occupancy" in doc else "")
+                 + (f"  prefix_hit={_fmt(doc.get('prefix_hit_rate'))}"
+                    if "prefix_hit_rate" in doc else ""))
+    if doc.get("replicas"):
+        lines.append("replicas:")
+        for r in doc["replicas"]:
+            flags = " retiring" if r.get("retiring") else ""
+            lines.append(f"  #{r['id']:<3} {r['health']:<10} "
+                         f"outstanding={r['outstanding']:<4} "
+                         f"running={r['running']:<3} queued={r['queued']}"
+                         f"{flags}")
+    c = doc.get("counters") or {}
+    if c:
+        lines.append("counters: " + "  ".join(f"{k}={v}"
+                                              for k, v in sorted(c.items())))
+    p = doc.get("pages")
+    if p:
+        lines.append(f"pages: in_use={_fmt(p.get('pages_in_use'), 0)}"
+                     f"/{_fmt(p.get('total_pages'), 0)}  "
+                     f"fragmentation={_fmt(p.get('page_fragmentation'))}  "
+                     f"shared={_fmt(p.get('prefix_shared_pages'), 0)}")
+    a = doc.get("autoscale")
+    if a:
+        lines.append(f"autoscale: target={a.get('target_replicas')} "
+                     f"ups={a.get('scale_ups')} downs={a.get('scale_downs')}")
+        for d in (a.get("last_decisions") or [])[-3:]:
+            lines.append(f"  {d.get('action'):<5} replica={d.get('replica')} "
+                         f"queue={d.get('queue_depth')} "
+                         f"ttft_p95={_fmt(d.get('ttft_p95_ms'))} "
+                         f"occ={_fmt(d.get('occupancy'))}")
+    an = doc.get("anomalies")
+    if an:
+        lines.append(f"anomalies: trips={an.get('trips')}")
+        for t in (an.get("recent") or [])[-3:]:
+            lines.append(f"  {t.get('signal')} value={_fmt(t.get('value'))} "
+                         f"score={_fmt(t.get('score'), 1)} "
+                         f"(threshold {_fmt(t.get('threshold'), 1)})")
+    f = doc.get("flight")
+    if f:
+        lines.append(f"flight: retained={f.get('retained_traces')} trace(s) "
+                     f"/ {f.get('retained_spans')} span(s)  "
+                     f"dumps={f.get('dumps')}  "
+                     f"slow_bar_ms={_fmt(f.get('slow_bar_ms'), 1)}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ds-tpu-top",
+        description="polling terminal view over a deepspeed-serve /statusz")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True,
+                    help="the --metrics-port of the serve process")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+    try:
+        while True:
+            try:
+                doc = fetch_status(args.host, args.port)
+                frame = render(doc)
+            except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+                frame = f"ds-tpu-top: {args.host}:{args.port} unreachable " \
+                        f"({type(e).__name__}: {e})\n"
+                if args.once:
+                    sys.stdout.write(frame)
+                    return 1
+            if args.once:
+                sys.stdout.write(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

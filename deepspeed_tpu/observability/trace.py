@@ -131,6 +131,9 @@ class Tracer:
         self._ids = itertools.count(1)
         self._pid_label = f"pid{os.getpid()}"
         self._stream = None
+        # span sinks: callables fed every FINISHED span (the flight recorder's
+        # attachment point). Empty-list check per commit — near-zero when none.
+        self._sinks: List = []
         # wall-anchored monotonic clock: cross-process lanes align on wall
         # time, in-process durations stay monotonic
         self._mono0 = time.monotonic()
@@ -164,6 +167,18 @@ class Tracer:
         if self._stream is not None:
             self._stream.close()
             self._stream = None
+
+    # ------------------------------------------------------------------ sinks
+    def add_sink(self, fn) -> None:
+        """Register a callable fed every finished span dict (commit order,
+        ingested spans included). The flight recorder attaches here; a sink
+        must be fast and must never raise."""
+        if fn not in self._sinks:
+            self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        if fn in self._sinks:
+            self._sinks.remove(fn)
 
     # ------------------------------------------------------------------ clock
     def ts_us(self, mono: Optional[float] = None) -> float:
@@ -262,6 +277,9 @@ class Tracer:
             self._spans.append(span)
         if self._stream is not None:
             self._stream.write(json.dumps(span) + "\n")
+        if self._sinks:
+            for fn in self._sinks:
+                fn(span)
 
     # ----------------------------------------------------------- cross-process
     def ingest(self, spans: List[Dict], pid_label: Optional[str] = None
@@ -269,6 +287,7 @@ class Tracer:
         """Merge spans exported by another process (its ``drain()`` output).
         Works even while this tracer is disabled — the parent may collect a
         child's spans without tracing itself."""
+        ingested = []
         with self._lock:
             for s in spans:
                 s = dict(s)
@@ -277,6 +296,11 @@ class Tracer:
                 if len(self._spans) == self._spans.maxlen:
                     self.dropped += 1
                 self._spans.append(s)
+                ingested.append(s)
+        if self._sinks:
+            for s in ingested:
+                for fn in self._sinks:
+                    fn(s)
 
     def drain(self) -> List[Dict]:
         """Remove and return every finished span (the subprocess streaming
@@ -295,29 +319,7 @@ class Tracer:
     # ---------------------------------------------------------------- exports
     def chrome_events(self) -> List[Dict]:
         """Chrome trace events ('X' completes + 'M' lane metadata)."""
-        spans = self.spans
-        pids: Dict[str, int] = {}
-        tids: Dict[tuple, int] = {}
-        events: List[Dict] = []
-        for s in spans:
-            pid = pids.setdefault(s["pid"], len(pids) + 1)
-            tkey = (s["pid"], s["tid"])
-            tid = tids.setdefault(tkey, len(tids) + 1)
-            args = {"trace_id": s["trace_id"], "span_id": s["span_id"]}
-            if s.get("parent_id"):
-                args["parent_id"] = s["parent_id"]
-            args.update(s.get("attrs") or {})
-            events.append({"name": s["name"], "cat": s["cat"], "ph": "X",
-                           "ts": s["ts"], "dur": max(s["dur"], 1.0),
-                           "pid": pid, "tid": tid, "args": args})
-        for label, pid in pids.items():
-            events.append({"name": "process_name", "ph": "M", "pid": pid,
-                           "tid": 0, "args": {"name": label}})
-        for (plabel, tlabel), tid in tids.items():
-            events.append({"name": "thread_name", "ph": "M",
-                           "pid": pids[plabel], "tid": tid,
-                           "args": {"name": tlabel}})
-        return events
+        return chrome_events_from(self.spans)
 
     def export_chrome(self, path: str) -> int:
         """Write Perfetto-loadable Chrome-trace JSON; returns the span count."""
@@ -326,6 +328,35 @@ class Tracer:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms",
                        "otherData": {"dropped_spans": self.dropped}}, f)
         return sum(1 for e in events if e["ph"] == "X")
+
+
+def chrome_events_from(spans: List[Dict]) -> List[Dict]:
+    """Chrome trace events ('X' completes + 'M' lane metadata) from finished
+    span dicts. Shared by :meth:`Tracer.chrome_events` and the flight
+    recorder's dump bundle (which exports RETAINED trees, not the whole ring),
+    so both artifacts stay Perfetto-loadable through one builder."""
+    pids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
+    events: List[Dict] = []
+    for s in spans:
+        pid = pids.setdefault(s["pid"], len(pids) + 1)
+        tkey = (s["pid"], s["tid"])
+        tid = tids.setdefault(tkey, len(tids) + 1)
+        args = {"trace_id": s["trace_id"], "span_id": s["span_id"]}
+        if s.get("parent_id"):
+            args["parent_id"] = s["parent_id"]
+        args.update(s.get("attrs") or {})
+        events.append({"name": s["name"], "cat": s["cat"], "ph": "X",
+                       "ts": s["ts"], "dur": max(s["dur"], 1.0),
+                       "pid": pid, "tid": tid, "args": args})
+    for label, pid in pids.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+    for (plabel, tlabel), tid in tids.items():
+        events.append({"name": "thread_name", "ph": "M",
+                       "pid": pids[plabel], "tid": tid,
+                       "args": {"name": tlabel}})
+    return events
 
 
 _tracer = Tracer()

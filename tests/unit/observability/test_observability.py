@@ -551,10 +551,17 @@ class TestOverheadSmoke:
         assert doc["metric"] == "obs_tracing_tpot_overhead_frac"
         g = doc["obs_gates"]
         for key in ("agg_tpot_ms_per_token_off", "agg_tpot_ms_per_token_on",
+                    "agg_tpot_ms_per_token_flight",
                     "tpot_overhead_frac", "tpot_within_2pct",
-                    "spans_per_on_rep"):
+                    "flight_overhead_frac", "flight_within_2pct",
+                    "spans_per_on_rep", "attribution_rows_per_flight_rep"):
             assert key in g
         assert g["spans_per_on_rep"] > 0           # tracing arm really traced
+        # the flight arm really attributed every completion
+        assert g["attribution_rows_per_flight_rep"] > 0
+        assert g["attribution_breakdown_emitted"] is True
+        bd = doc["detail"]["attribution"]
+        assert set(bd["p50_shares"]) == set(bd["p99_shares"])
         # rc reflects the gate; on a noisy CI host the smoke-size model can
         # exceed 2% — the committed BENCH_OBS artifact is the acceptance run
         assert rc in (0, 1)
