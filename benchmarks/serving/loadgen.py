@@ -262,7 +262,7 @@ def make_arrivals(args, rng, surges=(), mult_fn=None):
     return np.asarray(offs), widx
 
 
-def run_load(front, args, chaos=None, autoscaler=None) -> dict:
+def run_load(front, args, chaos=None, autoscaler=None, supervisor=None) -> dict:
     from deepspeed_tpu.inference.serving import (AdmissionDeferredError,
                                                  AdmissionShedError,
                                                  QueueFullError)
@@ -280,13 +280,16 @@ def run_load(front, args, chaos=None, autoscaler=None) -> dict:
     mult_fn = ((lambda t: chaos.load_multiplier(chaos.t0 + t))
                if chaos is not None else None)
     offs, widx = make_arrivals(args, rng, surges=surges, mult_fn=mult_fn)
-    t0 = time.monotonic()
-    arrivals = t0 + offs
     is_router = hasattr(front, "replicas")
     # parity references must outlive scale-down: replica 0 may detach mid-run,
-    # but the engine object (shared params) stays valid through this binding
+    # but the engine object (shared params) stays valid through this binding.
+    # Bound BEFORE the run clock starts: a hosted replica builds its parent
+    # reference engine lazily on first access, and paying that build after t0
+    # would read as queueing in the coordinated-omission-honest TTFT.
     ref_engine = (front.replicas[0].engine if is_router
                   else front.executor.engine)
+    t0 = time.monotonic()
+    arrivals = t0 + offs
     deadline_s = getattr(args, "deadline_s", None)
     # pending entries are mutable [ready_time, idx]: a rejected request backs
     # off independently (jittered), it never blocks later arrivals
@@ -300,6 +303,8 @@ def run_load(front, args, chaos=None, autoscaler=None) -> dict:
     while pending or front.busy:
         if autoscaler is not None:
             autoscaler.step()
+        if supervisor is not None:
+            supervisor.step()       # respawn dead hosted replicas (backoff)
         if chaos is not None:
             # polled AFTER the scaler so a when=draining event sees the
             # RETIRING state the scaler just entered — the retire sweep
@@ -353,6 +358,8 @@ def run_load(front, args, chaos=None, autoscaler=None) -> dict:
         while (len(front.replicas) > autoscaler.config.min_replicas
                and time.monotonic() - tail0 < 8.0):
             autoscaler.step()
+            if supervisor is not None:
+                supervisor.step()
             if chaos is not None:
                 chaos.poll(front)     # scale events mostly land in the tail;
                 #   poll between the scaler's begin_retire and the router's
@@ -412,6 +419,8 @@ def run_load(front, args, chaos=None, autoscaler=None) -> dict:
                                  for ev in chaos.events if not ev.fired]
     if autoscaler is not None:
         snap["autoscale"] = autoscaler.report()
+    if supervisor is not None:
+        snap["hosts"] = supervisor.report()
     if any(w is not None for w in widx):
         # per-schedule-window percentiles: the signal the autoscale bench is
         # judged on (a window's TTFT under surge vs the steady windows)
@@ -503,44 +512,116 @@ def run_load(front, args, chaos=None, autoscaler=None) -> dict:
     return snap
 
 
+def host_config(args):
+    """The one place loadgen args become a child-host spec (dims must mirror
+    the parity reference engine's)."""
+    from deepspeed_tpu.inference.serving import HostConfig
+    return HostConfig(vocab_size=args.vocab_size,
+                      max_seq_len=args.max_seq_len, n_embd=args.n_embd,
+                      n_layer=args.n_layer, n_head=args.n_head,
+                      slots=args.slots, chunk_size=args.chunk_size)
+
+
+def spawn_hosts(args, n, wait=True, env=None):
+    """N subprocess replica hosts (spawns overlap; optionally block until
+    every versioned hello lands). ``env`` overlays the child environment —
+    the hook the hosts bench uses to pace children into the device-bound
+    regime via the ``DS_TPU_FAULT_SPEC`` contract."""
+    import dataclasses
+    from deepspeed_tpu.inference.serving import HostedReplica
+    cfg = host_config(args)
+    if env:
+        cfg = dataclasses.replace(cfg, env=dict(env))
+    hosts = [HostedReplica(cfg) for _ in range(n)]
+    if wait:
+        for h in hosts:
+            h.wait_ready()
+    return hosts
+
+
+def close_hosts(front_or_hosts):
+    """Stop every hosted replica's child via the escalation ladder (accepts a
+    Router or a bare host list; a single-scheduler front is a no-op)."""
+    replicas = getattr(front_or_hosts, "replicas", None)
+    if replicas is None:
+        replicas = (front_or_hosts
+                    if isinstance(front_or_hosts, (list, tuple)) else [])
+    for r in replicas:
+        if getattr(r, "is_hosted", False):
+            r.close()
+
+
 def _build_router(args, serving_cfg, monitor=None, n_static=None, slo=None,
-                  shared_engine=None, engine_pool=None):
-    """Router (+ optional Autoscaler) for a loadgen lane. ``n_static``
-    overrides the replica count (the bench's static comparison lanes); with
-    ``--autoscale`` and no override, the router starts at ``--min-replicas``
-    and the autoscaler may grow it to ``--max-replicas`` through the engine
-    factory (weights shared with replica 0 — bit-identical replicas).
-    ``engine_pool`` supplies pre-built (warmed) engines: lanes draw their
-    replicas from it and the factory hands out currently-unattached pool
-    engines — the bench's stand-in for a fleet whose images are warm, so the
-    A/B measures the control loop, not XLA compiles the serial in-process
-    pump would otherwise absorb mid-surge."""
+                  shared_engine=None, engine_pool=None, host_pool=None):
+    """Router (+ optional Autoscaler/ReplicaSupervisor) for a loadgen lane.
+    ``n_static`` overrides the replica count (the bench's static comparison
+    lanes); with ``--autoscale`` and no override, the router starts at
+    ``--min-replicas`` and the autoscaler may grow it to ``--max-replicas``
+    through the engine factory (weights shared with replica 0 — bit-identical
+    replicas). ``engine_pool`` supplies pre-built (warmed) engines: lanes
+    draw their replicas from it and the factory hands out currently-unattached
+    pool engines — the bench's stand-in for a fleet whose images are warm, so
+    the A/B measures the control loop, not XLA compiles the serial in-process
+    pump would otherwise absorb mid-surge. With ``--host-replicas`` (or a
+    ``host_pool`` of pre-spawned ready hosts — the warm-fleet stand-in for
+    child processes, whose boot is jax import + XLA warm) the members are
+    subprocess :class:`HostedReplica`\\ s under a :class:`ReplicaSupervisor`,
+    and scale-ups attach hosts instead of engines."""
     from deepspeed_tpu.inference.serving import (Autoscaler, AutoscaleConfig,
-                                                 Router, RouterConfig)
+                                                 HostedReplica,
+                                                 ReplicaSupervisor, Router,
+                                                 RouterConfig,
+                                                 SupervisorConfig)
+    if serving_cfg is None:     # hosted lanes: the child carries its own
+        from deepspeed_tpu.inference.serving import ServingConfig
+        serving_cfg = ServingConfig(max_queue=args.max_queue)
+    hosted = bool(host_pool) or getattr(args, "host_replicas", False)
     autoscaled = n_static is None and args.autoscale
     # with --autoscale an explicit --replicas sets the STARTING size (bounded
     # below by --min-replicas) rather than being silently discarded
     n0 = (n_static if n_static is not None
           else (max(args.min_replicas, args.replicas) if args.autoscale
                 else args.replicas))
-    if engine_pool:
+    if hosted:
+        members = list(host_pool[:n0]) if host_pool else []
+        if len(members) < n0:
+            # top-ups clone the pool's child environment (e.g. the hosts
+            # bench's pacing overlay) — a differently-configured sibling
+            # would skew every per-replica comparison
+            members += spawn_hosts(
+                args, n0 - len(members),
+                env=(members[0].config.env if members else None))
+        first = None
+    elif engine_pool:
         first = engine_pool[0]
-        engines = list(engine_pool[:n0])
-        while len(engines) < n0:
-            engines.append(build_engine(args, params=first.params))
+        members = list(engine_pool[:n0])
+        while len(members) < n0:
+            members.append(build_engine(args, params=first.params))
     else:
         first = (shared_engine if shared_engine is not None
                  else build_engine(args))
-        engines = [first] + [build_engine(args, params=first.params)
+        members = [first] + [build_engine(args, params=first.params)
                              for _ in range(n0 - 1)]
     rcfg = RouterConfig(
         serving=serving_cfg, max_queue=args.max_queue,
         slo_admission=bool(args.slo_admission if slo is None else slo))
     if args.smoke:
-        rcfg.suspect_after_s, rcfg.dead_after_s = 0.05, 0.15
+        if hosted:
+            # heartbeats ride a 50ms child stream: a 0.15s flatline bound
+            # would false-kill a briefly descheduled healthy child
+            rcfg.suspect_after_s, rcfg.dead_after_s = 0.5, 1.5
+        else:
+            rcfg.suspect_after_s, rcfg.dead_after_s = 0.05, 0.15
         rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
         rcfg.retire_grace_s = 0.5
-    front = Router(engines, rcfg, monitor=monitor)
+    front = Router(members, rcfg, monitor=monitor)
+    supervisor = None
+    if hosted:
+        scfg = SupervisorConfig(max_restarts=args.max_restarts,
+                                backoff_base_s=args.restart_backoff)
+        if args.smoke:
+            scfg.backoff_base_s = min(scfg.backoff_base_s, 0.3)
+        supervisor = ReplicaSupervisor(front, scfg)
     autoscaler = None
     if autoscaled:
         acfg = AutoscaleConfig(min_replicas=args.min_replicas,
@@ -554,7 +635,25 @@ def _build_router(args, serving_cfg, monitor=None, n_static=None, slo=None,
             acfg.up_cooldown_s = 0.1
             acfg.occupancy_low = 0.45   # slots=1 pools: per-replica share of
             #   a 0.8x-capacity trough spread over 2-3 replicas
-        if engine_pool:
+        if hosted:
+            spare = list(host_pool or [])
+
+            def factory():
+                attached = {id(r) for r in front.replicas}
+                for h in spare:
+                    if id(h) not in attached and h.alive:
+                        return h           # warm fleet: pre-spawned + ready
+                # cold boot inherits the fleet's config (incl. any pacing
+                # env): an unpaced sibling in a paced fleet would be
+                # host-CPU-bound and skew the latency gate
+                cfg = (spare[0].config if spare
+                       else (front.replicas[0].config
+                             if front.replicas
+                             and getattr(front.replicas[0], "is_hosted",
+                                         False)
+                             else host_config(args)))
+                return HostedReplica(cfg)
+        elif engine_pool:
             spare = list(engine_pool)
 
             def factory():
@@ -567,7 +666,7 @@ def _build_router(args, serving_cfg, monitor=None, n_static=None, slo=None,
             def factory():
                 return build_engine(args, params=first.params)
         autoscaler = Autoscaler(front, factory, acfg)
-    return front, autoscaler
+    return front, autoscaler, supervisor
 
 
 def _run_autoscale_bench(args, serving_cfg, monitor) -> int:
@@ -673,11 +772,12 @@ def _run_autoscale_bench(args, serving_cfg, monitor) -> int:
         a = copy.copy(args)
         a.autoscale = args.autoscale if autoscale is None else autoscale
         a.deadline_s = deadline
-        front, autoscaler = _build_router(a, serving_cfg, monitor,
-                                          n_static=n_static, slo=slo,
-                                          engine_pool=pool)
+        front, autoscaler, supervisor = _build_router(
+            a, serving_cfg, monitor, n_static=n_static, slo=slo,
+            engine_pool=pool)
         print(f"[bench-autoscale] lane {name}...", file=sys.stderr)
-        snap = run_load(front, a, chaos=chaos, autoscaler=autoscaler)
+        snap = run_load(front, a, chaos=chaos, autoscaler=autoscaler,
+                        supervisor=supervisor)
         snap["lane"] = name
         return snap
 
@@ -929,6 +1029,22 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=1,
                     help=">=2 drives the multi-replica router")
+    ap.add_argument("--host-replicas", action="store_true",
+                    help="host each replica in its OWN supervised child "
+                         "process (serving.host): replicas pump "
+                         "concurrently, chaos kill/stall deliver real "
+                         "SIGKILL/SIGSTOP, dead children respawn with "
+                         "exponential backoff under --max-restarts")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="per-replica child respawn budget (hosted replicas)")
+    ap.add_argument("--restart-backoff", type=float, default=0.5,
+                    help="base seconds of the exponential respawn backoff")
+    ap.add_argument("--bench-hosts", action="store_true",
+                    help="acceptance A/B for process-parallel replica hosts: "
+                         "concurrency overlap via the span tracer, a real-"
+                         "SIGKILL + supervised-respawn soak, and the "
+                         "autoscaled-vs-static latency A/B with real "
+                         "per-replica compute; emits BENCH_HOSTS JSON")
     ap.add_argument("--autoscale", action="store_true",
                     help="attach the metrics-driven Autoscaler: start at "
                          "--min-replicas, scale within "
@@ -1066,6 +1182,12 @@ def main(argv=None) -> int:
                      "(or --autoscale)")
         if has_replica_event and args.chunk_deadline is None:
             args.chunk_deadline = 0.3
+    if args.host_replicas and args.prefix_cache:
+        ap.error("--host-replicas children manage their own KV; the parent-"
+                 "side --prefix-cache flags do not cross the pipe")
+    if args.host_replicas and (args.bench_paged or args.obs_ab):
+        ap.error("--bench-paged/--obs-ab measure the single-scheduler hot "
+                 "path; drop --host-replicas")
     if args.autoscale and args.max_replicas < args.min_replicas:
         ap.error("--max-replicas must be >= --min-replicas")
     if args.autoscale and args.replicas > args.max_replicas:
@@ -1084,12 +1206,19 @@ def main(argv=None) -> int:
         monitor = MonitorMaster(MonitorConfig(jsonl_monitor={
             "enabled": True, "output_path": args.jsonl_metrics,
             "job_name": "loadgen"}))
-    if (args.bench_paged or args.bench_autoscale) \
+    if (args.bench_paged or args.bench_autoscale or args.bench_hosts) \
             and (args.flight_out or args.trace_out):
         # these lanes dispatch before the tracer/flight wiring: refusing
         # beats silently writing no bundle the caller asked for
-        ap.error("--bench-paged/--bench-autoscale manage their own runs; "
-                 "--trace-out/--flight-out are single-run options")
+        ap.error("--bench-paged/--bench-autoscale/--bench-hosts manage "
+                 "their own runs; --trace-out/--flight-out are single-run "
+                 "options")
+    if args.bench_hosts:
+        # the bench pins its own geometry + arrival shape (self-calibrated)
+        if args.bench_paged or args.bench_autoscale or args.obs_ab:
+            ap.error("--bench-hosts is its own acceptance run; drop the "
+                     "other bench flags")
+        return _run_hosts_bench(args, monitor)
     if args.bench_paged:
         # dispatched before serving_cfg: the bench pins its own per-lane
         # geometries (and --kv-page-size may be None = per-lane default here)
@@ -1139,10 +1268,12 @@ def main(argv=None) -> int:
         get_registry().attach_monitor(detector)
     # SLO admission lives on the Router: --slo-admission must not silently
     # degrade to the admission-blind single-scheduler path
-    if args.replicas > 1 or args.autoscale or args.slo_admission:
-        front, autoscaler = _build_router(args, serving_cfg, monitor)
+    if args.replicas > 1 or args.autoscale or args.slo_admission \
+            or args.host_replicas:
+        front, autoscaler, supervisor = _build_router(args, serving_cfg,
+                                                      monitor)
     else:
-        autoscaler = None
+        autoscaler = supervisor = None
         front = ContinuousBatchingScheduler(build_engine(args), serving_cfg,
                                             monitor=monitor)
     chaos = None
@@ -1152,7 +1283,9 @@ def main(argv=None) -> int:
         # chaos run must never silently degrade to nothing
         from deepspeed_tpu.inference.serving import ChaosSchedule, parse_chaos
         chaos = ChaosSchedule(parse_chaos(args.chaos))
-    detail = run_load(front, args, chaos=chaos, autoscaler=autoscaler)
+    detail = run_load(front, args, chaos=chaos, autoscaler=autoscaler,
+                      supervisor=supervisor)
+    close_hosts(front)
     if recorder is not None:
         # "where did the p99 go": phase shares at p50 vs p99 over the run's
         # attribution rows, in the artifact next to the latency percentiles
@@ -1194,6 +1327,448 @@ def main(argv=None) -> int:
             out["trace"] = {"path": args.trace_out, "spans": n,
                             "dropped": tracer.dropped}
         tracer.disable()
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def _merge_intervals(iv):
+    """Sorted union of (t0, t1) intervals."""
+    out = []
+    for t0, t1 in sorted(iv):
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _overlap_seconds(lanes):
+    """Wall-clock seconds during which >= 2 lanes (each a merged interval
+    list, µs timestamps) are simultaneously busy."""
+    edges = []
+    for iv in lanes:
+        for t0, t1 in iv:
+            edges.append((t0, 1))
+            edges.append((t1, -1))
+    edges.sort()
+    depth, last_t, overlap = 0, None, 0.0
+    for t, d in edges:
+        if depth >= 2 and last_t is not None:
+            overlap += t - last_t
+        depth += d
+        last_t = t
+    return overlap / 1e6
+
+
+def _run_hosts_bench(args, monitor) -> int:
+    """Process-parallel replica hosts acceptance A/B (``BENCH_HOSTS`` JSON).
+
+    Four lanes, all over REAL child processes (``serving.host``), retiring the
+    ``BENCH_AUTOSCALE_r12`` harness caveat ("serial in-process pump: replica
+    count does not add host parallelism"):
+
+    - **concurrency** — 2 hosts behind the router under a saturating burst,
+      parent tracer ingesting the children's decode/prefill spans: the gate is
+      MEASURED wall-clock overlap (seconds during which both children have a
+      compute span open) > 0 — replica count now buys machine parallelism;
+    - **soak** — 3 supervised hosts under traffic with a real mid-decode
+      ``SIGKILL`` and a later ``SIGTERM`` kill: ``lost == 0``, every
+      evicted-and-retried request bit-identical to an unkilled reference
+      ``generate``, the supervisor respawns >= 1 child within the run, and
+      every chaos event fires (an unfired event fails the lane);
+    - **latency A/B** — over ``static_min`` (1 host), ``static_max`` (N
+      hosts), and ``autoscaled`` (1 -> N, scale-ups drawing pre-spawned warm
+      spares — the warm-fleet stand-in, since a cold child boot is a jax
+      import): the autoscaled lane must HOLD the coordinated-omission-honest
+      TTFT-p95 gate that the static-min lane BREACHES — the claim PR 12
+      filed as unmeasurable in-process — with ``lost == 0`` and bit-exact
+      parity across its scale churn. The A/B's children are PACED
+      device-bound replicas (fixed per-chunk delay via the
+      ``DS_TPU_FAULT_SPEC`` env contract): an unpaced toy child is
+      host-CPU-bound, and on a core-starved CI host N such processes share
+      one core's capacity — which measures the machine, not the serving
+      architecture. The offered swing self-calibrates against BOTH measured
+      capacities (one host's closed-loop rate and the N-host aggregate,
+      gated >= 1.8x apart) so the surge lands above the former and inside
+      the latter, with an r12-style re-offer when a machine-speed swing
+      dissolves the separation anyway.
+
+    ``--smoke`` runs concurrency + soak only (2 hosts, seconds-scale) — the
+    form the test suite executes; the committed artifact is a full run.
+    """
+    import copy
+    from deepspeed_tpu.inference.serving import (ChaosSchedule,
+                                                 QueueFullError, parse_chaos)
+    from deepspeed_tpu.observability.trace import get_tracer
+    args = copy.copy(args)
+    args.host_replicas = True
+    args.prefix_pool, args.prefix_cache = 0, False
+    args.verify_parity = False
+    args.autoscale = False
+    args.schedule_windows, args.deadline_s = None, None
+    if args.smoke:
+        args.vocab_size, args.max_seq_len = 96, 64
+        args.n_embd, args.n_layer, args.n_head = 32, 2, 4
+        args.slots, args.chunk_size = 1, 2
+        args.min_prompt, args.max_prompt = 3, 6
+        args.min_new, args.max_new = 8, 14
+        args.max_queue = 64
+        args.restart_backoff = 0.3
+    else:
+        args.vocab_size, args.max_seq_len = 96, 96
+        args.n_embd, args.n_layer, args.n_head = 32, 2, 4
+        args.slots, args.chunk_size = 1, 4
+        args.min_prompt, args.max_prompt = 3, 8
+        args.min_new, args.max_new = 24, 40
+        args.max_queue = 128
+    args.min_replicas, args.max_replicas = 1, 3
+
+    def drive(host, handles, timeout=120.0):
+        t0 = time.monotonic()
+        while any(not h.done for h in handles) \
+                and time.monotonic() - t0 < timeout:
+            host.step()
+        return [h.done for h in handles]
+
+    def warm(hosts, n=2):
+        # pay each child's prefill-bucket + chunk XLA compiles before any
+        # lane's clock starts (the warm-fleet premise)
+        rng = np.random.default_rng(7)
+        for h in hosts:
+            hs = []
+            for _ in range(n):
+                hs.append(h.submit(
+                    rng.integers(0, args.vocab_size, size=args.max_prompt
+                                 ).astype(np.int32),
+                    max_new_tokens=args.min_new))
+                drive(h, hs)
+
+    tracer = get_tracer()
+
+    # ---------------------------------------------------- concurrency lane
+    print("[bench-hosts] spawning 2 hosts (concurrency lane)...",
+          file=sys.stderr)
+    hosts = spawn_hosts(args, 2)
+    warm(hosts)
+    tracer.enable(pid_label="bench-hosts")
+    tracer.reset()
+    a = copy.copy(args)
+    a.requests = 16 if args.smoke else 48
+    a.rate = 1000.0                       # saturate both hosts
+    front, _, supervisor = _build_router(a, None, monitor, n_static=2,
+                                         host_pool=hosts)
+    conc = run_load(front, a, supervisor=supervisor)
+    # one more harvest round so the children's tail spans land in the parent
+    t_h = time.monotonic()
+    while time.monotonic() - t_h < 1.0:
+        front.step()
+    lanes_iv = {}
+    for s in tracer.spans:
+        if s["name"] in ("decode_chunk", "prefill", "suffix_prefill") \
+                and str(s["pid"]).startswith("host"):
+            lanes_iv.setdefault(s["pid"], []).append((s["ts"],
+                                                      s["ts"] + s["dur"]))
+    merged = {pid: _merge_intervals(iv) for pid, iv in lanes_iv.items()}
+    busy_s = {pid: sum(t1 - t0 for t0, t1 in iv) / 1e6
+              for pid, iv in merged.items()}
+    overlap_s = _overlap_seconds(list(merged.values()))
+    overlap_frac = (overlap_s / min(busy_s.values())
+                    if len(busy_s) >= 2 and min(busy_s.values()) > 0 else 0.0)
+    tracer.disable()
+    tracer.reset()
+    close_hosts(front)
+    conc["span_lanes"] = {pid: round(b, 4) for pid, b in busy_s.items()}
+    conc["overlap_s"] = overlap_s
+    conc["overlap_frac"] = overlap_frac
+    print(f"[bench-hosts] concurrency: busy={busy_s} overlap={overlap_s:.3f}s"
+          f" ({overlap_frac:.2%})", file=sys.stderr)
+
+    # ----------------------------------------------------------- soak lane
+    n_soak = 2 if args.smoke else 3
+    print(f"[bench-hosts] spawning {n_soak} hosts (SIGKILL+respawn soak)...",
+          file=sys.stderr)
+    hosts = spawn_hosts(args, n_soak)
+    warm(hosts)
+    a = copy.copy(args)
+    # saturating-ish: every replica stays mid-decode so the when=busy kill
+    # has a real window to land in
+    a.requests = 16 if args.smoke else 48
+    a.rate = 50.0 if args.smoke else 30.0
+    a.min_new, a.max_new = (16, 24) if args.smoke else (24, 40)
+    spec = "kill:replica=1,sig=KILL,when=busy"
+    if not args.smoke:
+        spec += ";kill:replica=2,sig=TERM,at=3.0"
+    chaos = ChaosSchedule(parse_chaos(spec))
+    front, _, supervisor = _build_router(a, None, monitor, n_static=n_soak,
+                                         host_pool=hosts)
+    front.config.recover_after_s = 2.0   # the bench proves the probe path;
+    #   it need not wait out the production recovery window
+    soak = run_load(front, a, chaos=chaos, supervisor=supervisor)
+    # post-storm supervision: keep the loop alive until the respawned child
+    # is re-admitted through the RECOVERING warm probe, then prove it serves
+    # again. The probe needs a BURST (not one request): dispatch prefers the
+    # least-loaded LIVE replica, so only overflow traffic reaches the
+    # half-open one.
+    from deepspeed_tpu.inference.serving import ReplicaState
+    rng = np.random.default_rng(11)
+    t0 = time.monotonic()
+    probes = []
+    while time.monotonic() - t0 < 90.0:
+        supervisor.step()
+        front.step()
+        if front.replica_state(1) == ReplicaState.LIVE:
+            break
+        r1 = front.replica_by_id(1)
+        if (front.replica_state(1) == ReplicaState.RECOVERING
+                and r1 is not None and r1.available > 0
+                and front.queue_depth == 0 and len(probes) < 64):
+            # probe traffic only once the respawned child can actually take
+            # one (hello landed, slots free): anything offered during its
+            # boot window just drains into the survivors and burns the
+            # probe budget before the half-open slot exists
+            try:
+                for _ in range(args.slots * n_soak + 2):
+                    probes.append(front.submit(
+                        rng.integers(0, args.vocab_size,
+                                     size=4).astype(np.int32),
+                        max_new_tokens=6))
+            except QueueFullError:
+                pass
+    while front.busy and time.monotonic() - t0 < 120.0:
+        supervisor.step()
+        front.step()
+    soak["respawned_back_live"] = \
+        front.replica_state(1) == ReplicaState.LIVE
+    soak["hosts"] = supervisor.report()
+    close_hosts(front)
+    print(f"[bench-hosts] soak: lost={soak['lost']} "
+          f"parity={soak.get('parity_ok')} "
+          f"restarts={soak['hosts']['restarts_total']} "
+          f"live_again={soak['respawned_back_live']}", file=sys.stderr)
+
+    # ----------------------------------------------------- latency A/B lanes
+    ab = None
+    if not args.smoke:
+        rng = np.random.default_rng(5)
+        mean_new = int(0.5 * (args.min_new + args.max_new))
+
+        def closed_loop_rate(front_or_host, K):
+            """Saturating closed-loop burst: true service rate of one host
+            (direct submit) or a whole router (aggregate)."""
+            t_cal = time.monotonic()
+            hs, remaining = [], K
+            while (remaining or any(not h.done for h in hs)) \
+                    and time.monotonic() - t_cal < 300.0:
+                while remaining:
+                    try:
+                        hs.append(front_or_host.submit(
+                            rng.integers(0, args.vocab_size,
+                                         size=args.max_prompt
+                                         ).astype(np.int32),
+                            max_new_tokens=mean_new))
+                        remaining -= 1
+                    except QueueFullError:
+                        break
+                front_or_host.step()
+            return K / (time.monotonic() - t_cal)
+
+        # the A/B's children are PACED device-bound replicas: every decode
+        # chunk carries a fixed delay via the DS_TPU_FAULT_SPEC env contract
+        # (the subprocess parity test's chunk-spacing idiom). Real replicas
+        # are device-bound — each owns its chip — but an unpaced toy child is
+        # host-CPU-bound, and on a core-starved CI host N such processes
+        # share ONE core's capacity (measured here: cap3 ~= cap1), so no
+        # offered surge can separate static_min from static_max. Pacing
+        # restores the regime the claim lives in: per-host capacity is bound
+        # by the (modeled) device step, host cores only run the light serving
+        # loop, and N hosts scale structurally.
+        from deepspeed_tpu.utils.fault_injection import FaultSpec, fault_env
+        pace_s = 0.025
+        pace_env = fault_env([("serving.decode_chunk",
+                               FaultSpec(kind="delay", delay_s=pace_s))],
+                             seed=1)
+
+        def ensure_pool(pool, n):
+            """Replace dead hosts (a prior lane's retire/kill closed them)
+            with fresh warmed spawns so every attempt starts whole."""
+            alive = [h for h in pool if h.alive]
+            if len(alive) < n:
+                fresh = spawn_hosts(args, n - len(alive), env=pace_env)
+                warm(fresh)
+                alive += fresh
+            return alive
+
+        # calibrate BOTH capacities: one host's service rate AND the full
+        # pool's measured aggregate — the surge must land above the former
+        # (static_min drowns) and inside the latter (static_max holds)
+        print("[bench-hosts] calibrating per-host + aggregate rates...",
+              file=sys.stderr)
+        pool1 = spawn_hosts(args, 1, env=pace_env)
+        warm(pool1)
+        cap1 = max(closed_loop_rate(pool1[0], 12)
+                   for _ in range(2))            # best-of-2: a transient
+        #   machine pause under-reads (the r12 calibration discipline)
+        pool_max = spawn_hosts(args, args.max_replicas, env=pace_env)
+        warm(pool_max)
+        cal_router, _, _cal_sup = _build_router(
+            copy.copy(args), None, monitor, n_static=args.max_replicas,
+            host_pool=pool_max)
+        cap_n = closed_loop_rate(cal_router, 12 * args.max_replicas)
+        auto_pool = spawn_hosts(args, args.max_replicas, env=pace_env)
+        warm(auto_pool)
+        req_floor = args.requests
+
+        def offer(surge, trough):
+            args.arrival = f"schedule:{trough}@2,{surge}@2,{trough}@10"
+            args.schedule_windows = parse_schedule(
+                args.arrival.split(":", 1)[1])
+            args.requests = min(400, max(req_floor, 72,
+                                         int(12 * trough + 2 * surge)))
+
+        def ab_lane(name, pool, n_static=None, autoscale=False):
+            a = copy.copy(args)
+            a.autoscale = autoscale
+            front, autoscaler, supervisor = _build_router(
+                a, None, monitor, n_static=n_static, host_pool=pool)
+            print(f"[bench-hosts] lane {name}: offering {a.arrival} over "
+                  f"{a.requests} requests...", file=sys.stderr)
+            snap = run_load(front, a, autoscaler=autoscaler,
+                            supervisor=supervisor)
+            snap["lane"] = name
+            return snap
+
+        def p95(s):
+            return s.get("ttft_e2e_ms_p95")
+
+        # the surge must straddle the two PROVISIONINGS: clearly above one
+        # host's rate (static_min must drown) yet inside the measured
+        # aggregate (static_max must hold) — with a re-offer pass because
+        # this machine's throughput swings between runs (the r12 bench's
+        # self-aware re-offer, pointed at separation instead of vacuousness)
+        surge = max(1.15 * cap1, min(2.5 * cap1, 0.8 * cap_n))
+        trough = 0.35 * cap1
+        print(f"[bench-hosts] cap1 ~{cap1:.1f} req/s, "
+              f"cap{args.max_replicas} ~{cap_n:.1f} req/s aggregate",
+              file=sys.stderr)
+        attempts = []
+        for attempt in range(3):
+            offer(round(surge, 2), round(trough, 2))
+            pool1 = ensure_pool(pool1, args.min_replicas)
+            static_min = ab_lane("static_min", pool1,
+                                 n_static=args.min_replicas)
+            pool_max = ensure_pool(pool_max, args.max_replicas)
+            static_max = ab_lane("static_max", pool_max,
+                                 n_static=args.max_replicas)
+            auto_pool = ensure_pool(auto_pool, args.max_replicas)
+            autoscaled = ab_lane("autoscaled", auto_pool, autoscale=True)
+            transient_ms = 1e3 * (autoscaled.get("autoscale") or {}).get(
+                "transient_s", 0.0)
+            gate_ms = (max(2.5 * p95(static_max), 1.2 * transient_ms)
+                       if p95(static_max) else None)
+            breaches = bool(gate_ms is not None
+                            and p95(static_min) is not None
+                            and p95(static_min) > gate_ms)
+            holds = bool(gate_ms is not None and p95(autoscaled) is not None
+                         and p95(autoscaled) <= gate_ms)
+            attempts.append({"attempt": attempt, "arrival": args.arrival,
+                             "requests": args.requests, "gate_ms": gate_ms,
+                             "static_min_p95": p95(static_min),
+                             "static_max_p95": p95(static_max),
+                             "autoscaled_p95": p95(autoscaled),
+                             "breaches": breaches, "holds": holds})
+            if breaches and holds:
+                break
+            if not breaches:
+                surge *= 1.35          # static_min survived: press harder
+            elif not holds:
+                surge *= 0.8           # even elastic capacity drowned: the
+                #   offered surge outran the machine, not the control loop
+            print(f"[bench-hosts] no separation (breaches={breaches}, "
+                  f"holds={holds}); re-offering", file=sys.stderr)
+        close_hosts(pool1)
+        close_hosts(pool_max)
+        close_hosts(auto_pool)
+        asr = autoscaled.get("autoscale") or {}
+        ab = {
+            "lanes": {"static_min": static_min, "static_max": static_max,
+                      "autoscaled": autoscaled},
+            "pace_chunk_delay_s": pace_s,
+            "pacing_note": "A/B children are paced device-bound replicas "
+                           "(fixed per-chunk delay via DS_TPU_FAULT_SPEC): "
+                           "an unpaced toy child is host-CPU-bound and N "
+                           "processes share one CI core's capacity, which "
+                           "measures the machine, not the serving "
+                           "architecture",
+            "capacity_req_s_per_host": cap1,
+            "capacity_req_s_aggregate": cap_n,
+            "parallel_speedup": (cap_n / cap1 if cap1 else None),
+            "offer_attempts": attempts,
+            "ttft_gate_ms": gate_ms,
+            "static_min_ttft_p95_ms": p95(static_min),
+            "static_max_ttft_p95_ms": p95(static_max),
+            "autoscaled_ttft_p95_ms": p95(autoscaled),
+            "static_min_breaches_gate": breaches,
+            "autoscaled_holds_gate": holds,
+            "scale_ups": asr.get("scale_ups", 0),
+            "scale_downs": asr.get("scale_downs", 0),
+            "autoscaled_lost": autoscaled.get("lost"),
+            "autoscaled_parity_ok": autoscaled.get("parity_ok", True),
+            "mean_replicas": {
+                "static_min": static_min.get("mean_replicas"),
+                "static_max": static_max.get("mean_replicas"),
+                "autoscaled": autoscaled.get("mean_replicas")},
+        }
+
+    gates = {
+        "harness_note": "replicas are real supervised child processes; the "
+                        "r12 'serial in-process pump' caveat is retired by "
+                        "this artifact",
+        "concurrent_pump_overlap_s": overlap_s,
+        "concurrent_pump_overlap_frac": overlap_frac,
+        "hosts_pump_concurrently": bool(overlap_s > 0
+                                        and len(busy_s) >= 2),
+        "soak_lost": soak["lost"],
+        "soak_chaos_exhausted": soak.get("chaos_exhausted", False),
+        "soak_parity_ok": soak.get("parity_ok", True),
+        "soak_restarts": soak["hosts"]["restarts_total"],
+        "supervised_respawn": soak["hosts"]["restarts_total"] >= 1,
+        "respawned_back_live": soak["respawned_back_live"],
+        "soak_ok": bool(soak["lost"] == 0
+                        and soak.get("chaos_exhausted", False)
+                        and soak.get("parity_ok", True)
+                        and soak["hosts"]["restarts_total"] >= 1),
+    }
+    checks = ["hosts_pump_concurrently", "soak_ok", "respawned_back_live"]
+    if ab is not None:
+        gates.update({
+            "parallel_speedup": ab["parallel_speedup"],
+            "aggregate_scales_with_hosts": bool(
+                ab["parallel_speedup"] is not None
+                and ab["parallel_speedup"] >= 1.8),
+            "ttft_gate_ms": ab["ttft_gate_ms"],
+            "static_min_breaches_gate": ab["static_min_breaches_gate"],
+            "autoscaled_holds_gate": ab["autoscaled_holds_gate"],
+            "autoscaled_ttft_p95_ms": ab["autoscaled_ttft_p95_ms"],
+            "static_min_ttft_p95_ms": ab["static_min_ttft_p95_ms"],
+            "scaled_up": ab["scale_ups"] >= 1,
+            "autoscaled_lost_zero": ab["autoscaled_lost"] == 0,
+            "autoscaled_parity_ok": ab["autoscaled_parity_ok"],
+            "r12_caveat_retired": bool(ab["static_min_breaches_gate"]
+                                       and ab["autoscaled_holds_gate"]),
+        })
+        checks += ["aggregate_scales_with_hosts",
+                   "static_min_breaches_gate", "autoscaled_holds_gate",
+                   "scaled_up", "autoscaled_lost_zero",
+                   "autoscaled_parity_ok"]
+    ok = all(bool(gates[k]) for k in checks)
+    out = {"metric": "hosts_concurrent_overlap_frac", "value": overlap_frac,
+           "unit": "frac", "smoke": bool(args.smoke),
+           "hosts_gates": gates, "gates_ok": ok,
+           "detail": {"concurrency": conc, "soak": soak,
+                      **({"latency_ab": ab} if ab is not None else {})}}
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)

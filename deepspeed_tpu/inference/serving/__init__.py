@@ -25,6 +25,12 @@ Layers (bottom-up):
   with least-outstanding dispatch, session affinity, the
   LIVE→SUSPECT→DEAD→RECOVERING health state machine, checkpointless request
   retry and SIGTERM graceful drain;
+- :mod:`host` — :class:`HostedReplica` + :class:`ReplicaSupervisor`:
+  process-parallel replica hosts — the same stack in supervised child
+  processes over the :mod:`subproc` JSONL pipe (async submit/harvest,
+  child-stamped heartbeats, real-signal chaos, bounded-backoff respawn
+  through the router's RECOVERING warm probe) so replica count finally buys
+  machine parallelism;
 - :mod:`autoscale` — :class:`Autoscaler` + :class:`ServiceTimeEstimator`: the
   elastic control plane — live metrics (queue depth, recent TTFT p95,
   occupancy) drive replica count with hysteresis + cooldown, and the online
@@ -40,6 +46,8 @@ Layers (bottom-up):
 from .autoscale import (Autoscaler, AutoscaleConfig, EstimatorConfig,
                         ServiceTimeEstimator)
 from .chaos import ChaosEvent, ChaosSchedule, parse_chaos
+from .host import (HostConfig, HostedReplica, ReplicaSupervisor,
+                   SupervisorConfig)
 from .executor import ChunkedDecodeExecutor, ChunkTimeoutError
 from .kv_pool import PagedKVPool, SlotKVPool
 from .prefix_cache import PrefixCache, PrefixCacheConfig
@@ -61,4 +69,5 @@ __all__ = [
     "RouterDrainingError", "ChaosEvent", "ChaosSchedule", "parse_chaos",
     "Autoscaler", "AutoscaleConfig", "EstimatorConfig", "ServiceTimeEstimator",
     "AdmissionShedError", "AdmissionDeferredError", "DegradationRung",
+    "HostConfig", "HostedReplica", "ReplicaSupervisor", "SupervisorConfig",
 ]

@@ -7,6 +7,12 @@ semicolon-separated events, each ``kind:key=val,...``:
     kill:replica=1,when=busy       # kill replica 1 the moment it has in-flight
                                    # work with >=1 generated token (guarantees a
                                    # real mid-decode eviction, deterministically)
+    kill:replica=1,sig=KILL        # hosted replicas: the REAL signal delivered
+                                   # to the child process — sig=KILL (default,
+                                   # the preempted-host model) or sig=TERM
+                                   # (child drains in-flight work, then exits);
+                                   # in-process replicas keep flag semantics
+                                   # (sig= is accepted and ignored there)
     kill:replica=1,when=restore    # kill replica 1 in the window BETWEEN its
                                    # next prefix-slab restore and the suffix
                                    # prefill (prefix-cache soak lane: guards the
@@ -17,7 +23,11 @@ semicolon-separated events, each ``kind:key=val,...``:
                                    # hold even when the drained replica dies)
     stall:replica=0,when=busy,s=0.6   # wedge replica 0's next chunk for 0.6s
                                       # (the chunk watchdog turns this into a
-                                      # ChunkTimeoutError)
+                                      # ChunkTimeoutError); against a HOSTED
+                                      # replica the wedge is a real
+                                      # SIGSTOP/SIGCONT on the child process —
+                                      # its heartbeat stream goes silent and
+                                      # the pipe-silence watchdog ages it
     revive:replica=1,at=2.0        # bring a killed replica back (RECOVERING
                                    # probe follows per the router state machine)
     surge:mult=4,at=1.0,s=2.0      # LOAD hook: multiply the offered arrival
@@ -65,6 +75,8 @@ class ChaosEvent:
     when: Optional[str] = None      # "busy" | "restore" | "draining"
     duration: float = 0.5           # stall seconds / surge window seconds
     mult: float = 2.0               # surge rate multiplier
+    sig: Optional[str] = None       # kill only: TERM | KILL — the real signal
+    #   a HOSTED replica's child receives (in-process kills stay flag-only)
     fired: bool = False
     armed: bool = False             # when=restore: hook installed, not yet hit
 
@@ -72,6 +84,14 @@ class ChaosEvent:
         if self.kind not in KINDS:
             raise ValueError(f"unknown chaos kind {self.kind!r} "
                              f"(expected one of {KINDS})")
+        if self.sig is not None:
+            if self.kind != "kill":
+                raise ValueError("sig= is a kill-only field "
+                                 f"(got it on {self.kind!r})")
+            self.sig = self.sig.upper()
+            if self.sig not in ("TERM", "KILL"):
+                raise ValueError(f"unknown kill signal sig={self.sig!r} "
+                                 "(expected TERM or KILL)")
         if self.kind == "surge":
             if self.at is None:
                 raise ValueError("chaos surge needs at=<s>")
@@ -114,6 +134,7 @@ def parse_chaos(spec: str) -> List[ChaosEvent]:
             replica=int(kv.get("replica", 0)),
             at=float(kv["at"]) if "at" in kv else None,
             when=kv.get("when"),
+            sig=kv.get("sig"),
             mult=float(kv.get("mult", 2.0)),
             duration=float(kv.get("s", kv.get("duration", 0.5)))))
     return events
@@ -214,12 +235,20 @@ class ChaosSchedule:
                 continue
             ev.fired = True
             if ev.kind == "kill":
-                replica.kill()
+                if getattr(replica, "is_hosted", False):
+                    # real-signal delivery to the child process; in-process
+                    # replicas keep the flag semantics below
+                    replica.kill(sig=ev.sig or "KILL")
+                else:
+                    replica.kill()
             elif ev.kind == "revive":
                 replica.revive()
             elif ev.kind == "stall":
+                # hosted replicas route this to a real SIGSTOP/SIGCONT via
+                # their executor view; in-process wedge the next chunk
                 replica.scheduler.executor.stall_next(ev.duration)
             logger.warning(f"[chaos] {ev.kind} replica {ev.replica}"
+                           + (f" sig={ev.sig}" if ev.sig else "")
                            + (f" ({ev.duration}s)" if ev.kind == "stall"
                               else "")
                            + (" (mid-retire)" if ev.when == "draining"

@@ -41,13 +41,18 @@ unit of recovery on the inference path**:
   healthy → defer-low → shed-infeasible → admission-closed
   (:class:`DegradationRung`), observable as ``router/degradation_rung``.
 
-Replicas here are in-process (:class:`EngineReplica`: one engine + one
-scheduler each — separate meshes in multi-chip deployments), with death/stall
-simulated through ``kill()``/``stall_next`` and the fault registry; the
-``DS_TPU_FAULT_SPEC`` env contract (``utils.fault_injection``) carries the same
-seeded schedules into subprocess-hosted replicas, whose router-side view would
-be the streamed token prefixes this module already treats as the only
-recoverable state.
+Replicas come in two forms behind one protocol: in-process
+(:class:`EngineReplica`: one engine + one scheduler, death/stall simulated
+through ``kill()``/``stall_next`` and the fault registry — but the pump is
+SERIAL, so replica count adds no machine parallelism) and **process-parallel
+hosts** (:class:`~.host.HostedReplica`: the same stack in a supervised child
+process over the ``subproc.py`` JSONL pipe — async submit/harvest, heartbeats
+stamped from child step messages, real SIGKILL/SIGSTOP chaos, bounded-backoff
+respawn via :class:`~.host.ReplicaSupervisor`). A router may mix both; either
+way the router-side view of a replica is the streamed token prefixes this
+module treats as the only recoverable state. The ``DS_TPU_FAULT_SPEC`` env
+contract (``utils.fault_injection``) carries seeded fault schedules into the
+child processes.
 
 Threading: like the scheduler, the router is single-threaded — drive ``step()``
 / ``run()`` from one thread. ``RouterRequest.cancel`` and ``begin_drain`` only
@@ -56,6 +61,7 @@ set flags and are safe from signal handlers / other threads.
 
 import itertools
 import signal
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -524,7 +530,7 @@ class Router:
         if not engines:
             raise ValueError("router needs at least one engine replica")
         self.config = cfg = config or RouterConfig()
-        self.replicas = [EngineReplica(i, e, cfg.serving)
+        self.replicas = [self._as_replica(e, i)
                          for i, e in enumerate(engines)]
         self.cap = self.replicas[0].scheduler.cap
         self.max_prompt_len = self.replicas[0].scheduler.executor.max_prompt_len
@@ -943,6 +949,16 @@ class Router:
             raise KeyError(f"replica {replica_id} is not attached")
         return r
 
+    def _as_replica(self, item, replica_id: int):
+        """Engine objects wrap in an in-process :class:`EngineReplica`;
+        objects already implementing the replica protocol (``host.py``'s
+        subprocess-hosted :class:`~.host.HostedReplica`) join the set as
+        themselves — a router may mix both."""
+        if getattr(item, "replica_protocol", False):
+            item.bind(replica_id)
+            return item
+        return EngineReplica(replica_id, item, self.config.serving)
+
     # ----------------------------------------------------- elastic replica set
     def add_replica(self, engine, warm: bool = True) -> EngineReplica:
         """Attach a new replica (autoscaler scale-up). Ids are monotonic and
@@ -957,7 +973,7 @@ class Router:
             raise RouterDrainingError()
         rid = self._next_replica_id
         self._next_replica_id += 1
-        replica = EngineReplica(rid, engine, self.config.serving)
+        replica = self._as_replica(engine, rid)
         self.replicas.append(replica)
         self._dispatched[rid] = []
         self.health[rid] = ReplicaHealth(
@@ -1029,6 +1045,15 @@ class Router:
         self.retired.append(replica.id)
         self._detached_tokens += replica.scheduler.telemetry.tokens_total
         self.health[replica.id].retiring = False
+        if getattr(replica, "is_hosted", False):
+            # a detached host's child must not outlive its membership — but
+            # the stop ladder (drain → SIGTERM → SIGKILL) can legitimately
+            # take seconds on a wedged child, and this sweep runs inside the
+            # single-threaded serving loop: close on a reaper thread so the
+            # survivors' dispatch/harvest never stalls behind it (the ladder
+            # still guarantees the child is reaped)
+            threading.Thread(target=replica.close, daemon=True,
+                             name=f"host-close-{replica.id}").start()
         for sess in [s for s, rid in self._affinity.items()
                      if rid == replica.id]:
             del self._affinity[sess]

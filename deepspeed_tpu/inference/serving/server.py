@@ -24,6 +24,12 @@ scale-down retires gracefully (in-flight requests migrate bit-identically).
 ``--slo-admission`` sheds requests whose estimated completion misses their
 ``deadline_s`` at admission (an ``{"error": ...}`` line with the retry-after
 hint) instead of letting them expire after burning decode steps.
+``--host-replicas`` hosts each replica in its OWN supervised child process
+(``serving.host``): replicas pump concurrently instead of sharing one serial
+loop, chaos ``kill``/``stall`` deliver real SIGKILL/SIGSTOP, and a
+``ReplicaSupervisor`` respawns dead children with exponential backoff under
+``--max-restarts`` (exhausted budget pins the replica DEAD; survivors keep
+serving). ``/statusz`` then carries child PIDs and restart counts.
 ``--chaos "<spec>"`` schedules replica kills/stalls (see ``serving.chaos``), and
 a ``DS_TPU_FAULT_SPEC`` env (``utils.fault_injection.fault_env``) is armed at
 startup — the hook chaos tests use to inject deterministically into
@@ -80,6 +86,14 @@ def _build_engines(args, n: int):
                       for _ in range(n - 1)]
 
 
+def _close_hosts(front) -> None:
+    """Stop every hosted replica's child through the escalation ladder (a
+    no-op for in-process replicas / the single-scheduler front)."""
+    for r in getattr(front, "replicas", []):
+        if getattr(r, "is_hosted", False):
+            r.close()
+
+
 def _make_monitor(args) -> Optional[object]:
     if not args.jsonl_metrics:
         return None
@@ -91,12 +105,13 @@ def _make_monitor(args) -> Optional[object]:
 
 
 def make_status_provider(front, autoscaler=None, recorder=None,
-                         detector=None):
+                         detector=None, supervisor=None):
     """``/statusz`` JSON assembler over a serving frontend (scheduler or
-    router): replica health + outstanding work, queue depth, degradation
-    rung, paged-KV pressure, prefix hit rate, recent anomaly trips, the last
-    autoscale decisions with their triggering signals, and the flight
-    recorder's retention stats."""
+    router): replica health + outstanding work (hosted replicas add child
+    PID + restart count), queue depth, degradation rung, paged-KV pressure,
+    prefix hit rate, recent anomaly trips, the last autoscale decisions with
+    their triggering signals, the replica supervisor's restart/pinned
+    accounting, and the flight recorder's retention stats."""
     is_router = hasattr(front, "replicas")
 
     def status():
@@ -115,7 +130,9 @@ def make_status_provider(front, autoscaler=None, recorder=None,
                      "outstanding": r.outstanding,
                      "running": r.running,
                      "queued": r.queued,
-                     "retiring": front.health[r.id].retiring}
+                     "retiring": front.health[r.id].retiring,
+                     **({"pid": r.child_pid, "restarts": r.restarts}
+                        if getattr(r, "is_hosted", False) else {})}
                     for r in front.replicas],
                 "retired_replicas": list(front.retired),
                 "counters": {
@@ -163,6 +180,8 @@ def make_status_provider(front, autoscaler=None, recorder=None,
                 "scale_ups": autoscaler.scale_ups,
                 "scale_downs": autoscaler.scale_downs,
                 "last_decisions": list(autoscaler.decisions)[-5:]}
+        if supervisor is not None:
+            doc["hosts"] = supervisor.report()
         if detector is not None:
             doc["anomalies"] = {"trips": detector.trips,
                                 "recent": list(detector.recent)[-8:]}
@@ -208,7 +227,7 @@ def _result_line(h) -> str:
 
 
 def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None,
-                 autoscaler=None):
+                 autoscaler=None, supervisor=None):
     """Streaming serve loop: requests are admitted as their lines arrive (a
     reader thread feeds a queue, so a client may keep the pipe open and read
     results before sending more) and each result is emitted the moment its
@@ -246,6 +265,8 @@ def _serve_stdin(sched, out=sys.stdout, inp=None, chaos=None,
             chaos.poll(sched)
         if autoscaler is not None:
             autoscaler.step()
+        if supervisor is not None:
+            supervisor.step()       # respawn dead hosted replicas (backoff)
         while True:                          # drain whatever the reader has
             try:
                 line = lines.get_nowait()
@@ -396,6 +417,18 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queue", type=int, default=32)
     ap.add_argument("--replicas", type=int, default=1,
                     help=">=2 serves through the multi-replica router")
+    ap.add_argument("--host-replicas", action="store_true",
+                    help="host each replica in its OWN supervised child "
+                         "process (serving.host): replicas pump concurrently "
+                         "instead of sharing one serial loop, chaos kills/"
+                         "stalls deliver real SIGKILL/SIGSTOP, and a "
+                         "ReplicaSupervisor respawns dead children with "
+                         "exponential backoff under --max-restarts")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="per-replica child respawn budget (hosted replicas; "
+                         "exhausted -> pinned DEAD, survivors keep serving)")
+    ap.add_argument("--restart-backoff", type=float, default=0.5,
+                    help="base seconds of the exponential respawn backoff")
     ap.add_argument("--autoscale", action="store_true",
                     help="metrics-driven autoscaling: start at --min-replicas "
                          "and let the control plane scale within "
@@ -538,9 +571,11 @@ def main(argv=None) -> int:
         recorder.monitor = monitor
     chaos = None
     autoscaler = None
+    supervisor = None
     # SLO admission lives on the Router: a bare --slo-admission must not
     # silently degrade to the admission-blind single-scheduler path
-    if args.replicas > 1 or args.autoscale or args.slo_admission:
+    if args.replicas > 1 or args.autoscale or args.slo_admission \
+            or args.host_replicas:
         from .autoscale import Autoscaler, AutoscaleConfig
         from .chaos import ChaosSchedule, parse_chaos
         from .router import Router, RouterConfig
@@ -551,36 +586,86 @@ def main(argv=None) -> int:
         # (bounded below by --min-replicas), it is not silently discarded
         n0 = (max(args.min_replicas, args.replicas) if args.autoscale
               else args.replicas)
-        engines = _build_engines(args, n0)
         rcfg = RouterConfig(serving=serving_cfg, max_queue=args.max_queue,
                             slo_admission=args.slo_admission)
-        if args.selftest:
-            # tight health thresholds: the kill-and-retry round trip should
-            # prove itself in ~a second, not wait out production timeouts
-            rcfg.suspect_after_s, rcfg.dead_after_s = 0.05, 0.15
-            rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
-        front = Router(engines, rcfg, monitor=monitor)
+        if args.host_replicas:
+            from .host import (HostConfig, HostedReplica, ReplicaSupervisor,
+                               SupervisorConfig)
+            if args.checkpoint:
+                raise SystemExit("--host-replicas serves the deterministic-"
+                                 "init model; --checkpoint does not cross "
+                                 "the pipe")
+            if args.dtype != "float32" or args.tp != 1:
+                raise SystemExit("--host-replicas children build float32 "
+                                 "tp=1 engines (the determinism contract "
+                                 "behind bit-exact retry parity)")
+            if args.prefix_cache or args.kv_pool != "paged" \
+                    or args.chunk_deadline is not None:
+                # refuse rather than silently serve without the protection/
+                # optimization the operator asked for: these knobs configure
+                # the CHILD's scheduler and are not wired over the pipe yet
+                raise SystemExit(
+                    "--host-replicas children manage their own serving "
+                    "config; --prefix-cache/--kv-pool/--chunk-deadline do "
+                    "not cross the pipe (ROADMAP: HostConfig knobs)")
+            hcfg = HostConfig(
+                family=args.family, vocab_size=args.vocab_size,
+                max_seq_len=args.max_seq_len, n_embd=args.n_embd,
+                n_layer=args.n_layer, n_head=args.n_head, slots=args.slots,
+                chunk_size=args.chunk_size)
+            members = [HostedReplica(hcfg) for _ in range(n0)]
+            for m in members:
+                m.wait_ready()
+            engines = None
+            engine_factory = lambda: HostedReplica(hcfg)   # noqa: E731
+            if args.selftest:
+                # looser than the in-process selftest: heartbeats ride a
+                # 50ms child stream, and a 0.15s flatline bound would
+                # false-kill a briefly descheduled healthy child
+                rcfg.suspect_after_s, rcfg.dead_after_s = 0.5, 1.5
+                rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
+        else:
+            engines = _build_engines(args, n0)
+            members = engines
+            engine_factory = lambda: _build_engine(   # noqa: E731
+                args, params=engines[0].params)
+            if args.selftest:
+                # tight health thresholds: the kill-and-retry round trip
+                # should prove itself in ~a second, not wait out production
+                # timeouts
+                rcfg.suspect_after_s, rcfg.dead_after_s = 0.05, 0.15
+                rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
+        front = Router(members, rcfg, monitor=monitor)
         front.install_sigterm_drain()      # SIGTERM = graceful drain
+        if args.host_replicas:
+            supervisor = ReplicaSupervisor(front, SupervisorConfig(
+                max_restarts=args.max_restarts,
+                backoff_base_s=args.restart_backoff))
         if args.autoscale:
             autoscaler = Autoscaler(
-                front, lambda: _build_engine(args, params=engines[0].params),
+                front, engine_factory,
                 AutoscaleConfig(min_replicas=args.min_replicas,
                                 max_replicas=args.max_replicas))
         if args.chaos:
             chaos = ChaosSchedule(parse_chaos(args.chaos))
         _providers["status"] = make_status_provider(
             front, autoscaler=autoscaler, recorder=recorder,
-            detector=detector)
+            detector=detector, supervisor=supervisor)
         _providers["health"] = make_health_provider(front)
         if args.selftest:
-            ok, snap = _selftest_router(front, engines, args.requests,
+            ref_engines = (engines if engines is not None
+                           else [members[0].engine])
+            ok, snap = _selftest_router(front, ref_engines, args.requests,
                                         args.vocab_size)
+            _close_hosts(front)
             print(json.dumps({"selftest_ok": ok, **snap}))
             _obs_epilogue()
             return 0 if ok else 1
     else:
         if args.chaos:
             raise SystemExit("--chaos needs --replicas >= 2")
+        if args.host_replicas:
+            raise SystemExit("--host-replicas serves through the router")
         engine = _build_engine(args)
         front = ContinuousBatchingScheduler(engine, serving_cfg,
                                             monitor=monitor)
@@ -592,7 +677,9 @@ def main(argv=None) -> int:
             print(json.dumps({"selftest_ok": ok, **snap}))
             _obs_epilogue()
             return 0 if ok else 1
-    snap = _serve_stdin(front, chaos=chaos, autoscaler=autoscaler)
+    snap = _serve_stdin(front, chaos=chaos, autoscaler=autoscaler,
+                        supervisor=supervisor)
+    _close_hosts(front)
     print(json.dumps(snap), file=sys.stderr)
     _obs_epilogue()
     return 0

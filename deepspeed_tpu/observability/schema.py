@@ -73,6 +73,13 @@ TAGS: Dict[str, Tuple[str, str]] = {
     "autoscale/scale_down_total": (COUNTER, "replicas retired by the autoscaler"),
     "autoscale/replica_seconds": (COUNTER, "integrated attached-replica "
                                            "seconds (provisioned capacity)"),
+    # --------------------------------------- hosted replica supervision (PR 15)
+    "host/restarts_total": (COUNTER, "supervised child-process respawns "
+                                     "across hosted replicas"),
+    "host/backoff_s": (GAUGE, "longest pending respawn backoff (0 = none)"),
+    "host/child_rss_bytes": (GAUGE, "max child RSS across hosted replicas"),
+    "host/pipe_lag_ms": (GAUGE, "max heartbeat pipe transit+age across "
+                                "hosted replicas"),
     # ---------------------------------------------------------------- training
     "Train/Samples/train_loss": (GAUGE, "loss at each optimizer step"),
     "Train/Samples/lr": (GAUGE, "learning rate at each optimizer step"),
@@ -170,6 +177,7 @@ EMITTER_MODULES = (
     "deepspeed_tpu/inference/serving/telemetry.py",
     "deepspeed_tpu/inference/serving/router.py",
     "deepspeed_tpu/inference/serving/autoscale.py",
+    "deepspeed_tpu/inference/serving/host.py",
     "deepspeed_tpu/runtime/engine.py",
     "deepspeed_tpu/inference/engine.py",
     "deepspeed_tpu/observability/metrics.py",

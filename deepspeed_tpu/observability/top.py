@@ -60,10 +60,17 @@ def render(doc: Dict) -> str:
         lines.append("replicas:")
         for r in doc["replicas"]:
             flags = " retiring" if r.get("retiring") else ""
+            if "pid" in r:       # hosted replica: child process + respawns
+                flags += f" pid={r['pid']} restarts={r.get('restarts', 0)}"
             lines.append(f"  #{r['id']:<3} {r['health']:<10} "
                          f"outstanding={r['outstanding']:<4} "
                          f"running={r['running']:<3} queued={r['queued']}"
                          f"{flags}")
+    h = doc.get("hosts")
+    if h:
+        pinned = h.get("pinned") or []
+        lines.append(f"hosts: restarts={h.get('restarts_total')}"
+                     + (f"  pinned={pinned}" if pinned else ""))
     c = doc.get("counters") or {}
     if c:
         lines.append("counters: " + "  ".join(f"{k}={v}"

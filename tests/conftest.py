@@ -67,6 +67,12 @@ def pytest_configure(config):
         "(autoscaler scale-up/down, hysteresis, SLO admission shed-vs-"
         "expire, degradation ladder, drain-parity on scale-down, "
         "chaos-during-scale, loadgen schedule smoke) — tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "serving_host: process-parallel replica hosts lane "
+        "(subproc protocol hello/quarantine/stop-ladder, HostedReplica "
+        "router membership, ReplicaSupervisor restart storm + budget, "
+        "chaos sig= grammar, real SIGKILL+respawn parity) — tier-1 fast "
+        "lane; its bench smoke is marked slow")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -89,7 +95,8 @@ def pytest_collection_modifyitems(config, items):
                 or it.get_closest_marker("serving_router") is not None \
                 or it.get_closest_marker("prefix_cache") is not None \
                 or it.get_closest_marker("paged_kv") is not None \
-                or it.get_closest_marker("serving_autoscale") is not None:
+                or it.get_closest_marker("serving_autoscale") is not None \
+                or it.get_closest_marker("serving_host") is not None:
             return 3
         if it.get_closest_marker("comm_overlap") is not None:
             return 4

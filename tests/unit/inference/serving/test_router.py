@@ -357,11 +357,19 @@ def test_fault_env_propagates_into_subprocess():
 
 
 # ------------------------------------------------------------- chaos smoke
+@pytest.mark.slow
 def test_chaos_soak_smoke(engines, tmp_path, capsys):
     """The acceptance rig: ≥2 replicas under Poisson load with a scheduled
     mid-run kill + one injected chunk stall — every admitted request completes
     (lost == 0), evicted requests are bit-identical to unkilled greedy runs,
-    and per-replica health/retry/eviction metrics land in the monitor stream."""
+    and per-replica health/retry/eviction metrics land in the monitor stream.
+
+    Marked ``slow`` (tier-1 window pressure, PR 15): this exact loadgen
+    chaos-soak harness also runs in-window as the observability acceptance
+    lane (``test_observability.py`` soak: same kill/stall spec PLUS trace
+    joins and /metrics-vs-BENCH parity), and the hosted-replica flagship
+    (``test_host.py``) soaks the stronger real-signal variant — the
+    in-window duplicates keep the coverage."""
     spec = importlib.util.spec_from_file_location(
         "serving_loadgen", os.path.join(REPO, "benchmarks", "serving",
                                         "loadgen.py"))
