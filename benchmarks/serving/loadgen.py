@@ -514,25 +514,40 @@ def run_load(front, args, chaos=None, autoscaler=None, supervisor=None) -> dict:
 
 def host_config(args):
     """The one place loadgen args become a child-host spec (dims must mirror
-    the parity reference engine's)."""
+    the parity reference engine's). Serving knobs cross the pipe as child
+    argv: each child builds its own prefix cache / paged pool / watchdog."""
     from deepspeed_tpu.inference.serving import HostConfig
     return HostConfig(vocab_size=args.vocab_size,
                       max_seq_len=args.max_seq_len, n_embd=args.n_embd,
                       n_layer=args.n_layer, n_head=args.n_head,
-                      slots=args.slots, chunk_size=args.chunk_size)
+                      slots=args.slots, chunk_size=args.chunk_size,
+                      prefix_cache=args.prefix_cache,
+                      prefix_cache_mb=(args.prefix_cache_mb
+                                       if args.prefix_cache else None),
+                      prefix_min_hit=(args.prefix_min_hit
+                                      if args.prefix_cache else None),
+                      kv_pool=args.kv_pool, kv_page_size=args.kv_page_size,
+                      chunk_deadline_s=args.chunk_deadline)
 
 
-def spawn_hosts(args, n, wait=True, env=None):
+def spawn_hosts(args, n, wait=True, env=None, transport=None):
     """N subprocess replica hosts (spawns overlap; optionally block until
     every versioned hello lands). ``env`` overlays the child environment —
     the hook the hosts bench uses to pace children into the device-bound
-    regime via the ``DS_TPU_FAULT_SPEC`` contract."""
+    regime via the ``DS_TPU_FAULT_SPEC`` contract. ``transport`` overrides
+    ``--host-transport``: ``"socket"`` spawns children that carry protocol
+    v1 over the CRC-framed TCP transport (serving.net) instead of the
+    stdio pipe."""
     import dataclasses
-    from deepspeed_tpu.inference.serving import HostedReplica
+    from deepspeed_tpu.inference.serving import (HostedReplica,
+                                                 SocketHostedReplica)
     cfg = host_config(args)
     if env:
         cfg = dataclasses.replace(cfg, env=dict(env))
-    hosts = [HostedReplica(cfg) for _ in range(n)]
+    sock = (transport or getattr(args, "host_transport",
+                                 "stdio")) == "socket"
+    cls = SocketHostedReplica if sock else HostedReplica
+    hosts = [cls(cfg) for _ in range(n)]
     if wait:
         for h in hosts:
             h.wait_ready()
@@ -575,7 +590,9 @@ def _build_router(args, serving_cfg, monitor=None, n_static=None, slo=None,
     if serving_cfg is None:     # hosted lanes: the child carries its own
         from deepspeed_tpu.inference.serving import ServingConfig
         serving_cfg = ServingConfig(max_queue=args.max_queue)
-    hosted = bool(host_pool) or getattr(args, "host_replicas", False)
+    endpoints = getattr(args, "replica_endpoint", None)
+    hosted = bool(host_pool) or getattr(args, "host_replicas", False) \
+        or bool(endpoints)
     autoscaled = n_static is None and args.autoscale
     # with --autoscale an explicit --replicas sets the STARTING size (bounded
     # below by --min-replicas) rather than being silently discarded
@@ -584,13 +601,22 @@ def _build_router(args, serving_cfg, monitor=None, n_static=None, slo=None,
                 else args.replicas))
     if hosted:
         members = list(host_pool[:n0]) if host_pool else []
+        if not members and endpoints:
+            # adopt running socket children: each endpoint is one member,
+            # dialed (not spawned) — geometry flags must match the remote's
+            from deepspeed_tpu.inference.serving import SocketHostedReplica
+            members = [SocketHostedReplica(host_config(args), endpoint=ep)
+                       for ep in endpoints[:n0]]
+            for m in members:
+                m.wait_ready()
         if len(members) < n0:
             # top-ups clone the pool's child environment (e.g. the hosts
             # bench's pacing overlay) — a differently-configured sibling
             # would skew every per-replica comparison
             members += spawn_hosts(
                 args, n0 - len(members),
-                env=(members[0].config.env if members else None))
+                env=(members[0].config.env
+                     if members and not endpoints else None))
         first = None
     elif engine_pool:
         first = engine_pool[0]
@@ -652,6 +678,14 @@ def _build_router(args, serving_cfg, monitor=None, n_static=None, slo=None,
                              and getattr(front.replicas[0], "is_hosted",
                                          False)
                              else host_config(args)))
+                if getattr(args, "host_transport", "stdio") == "socket" \
+                        or endpoints:
+                    # grow-by-spawn always spawns locally, matching the
+                    # fleet's transport (an endpoint fleet grows with a
+                    # local socket child — nobody listens at a new address)
+                    from deepspeed_tpu.inference.serving import \
+                        SocketHostedReplica
+                    return SocketHostedReplica(cfg)
                 return HostedReplica(cfg)
         elif engine_pool:
             spare = list(engine_pool)
@@ -1039,6 +1073,23 @@ def main(argv=None) -> int:
                     help="per-replica child respawn budget (hosted replicas)")
     ap.add_argument("--restart-backoff", type=float, default=0.5,
                     help="base seconds of the exponential respawn backoff")
+    ap.add_argument("--host-transport", default="stdio",
+                    choices=("stdio", "socket"),
+                    help="hosted-replica transport: 'stdio' (default) = "
+                         "JSONL over the child pipe; 'socket' = protocol v1 "
+                         "in CRC-framed TCP (serving.net) with session-token "
+                         "redial and the net:* chaos seam")
+    ap.add_argument("--replica-endpoint", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="adopt an already-running socket replica child "
+                         "(--serve-socket --listen) at this address; "
+                         "repeatable — each endpoint is one router member")
+    ap.add_argument("--bench-net", action="store_true",
+                    help="acceptance A/B for the socket replica transport: "
+                         "stdio-vs-socket throughput at equal replica count, "
+                         "a partition+delay+SIGKILL chaos soak over a "
+                         "3-replica socket fleet, and a delay-jitter "
+                         "no-false-kill lane; emits BENCH_NET JSON")
     ap.add_argument("--bench-hosts", action="store_true",
                     help="acceptance A/B for process-parallel replica hosts: "
                          "concurrency overlap via the span tracer, a real-"
@@ -1182,12 +1233,15 @@ def main(argv=None) -> int:
                      "(or --autoscale)")
         if has_replica_event and args.chunk_deadline is None:
             args.chunk_deadline = 0.3
-    if args.host_replicas and args.prefix_cache:
-        ap.error("--host-replicas children manage their own KV; the parent-"
-                 "side --prefix-cache flags do not cross the pipe")
-    if args.host_replicas and (args.bench_paged or args.obs_ab):
+    if args.replica_endpoint:
+        # the endpoint list defines the fleet floor (each endpoint is one
+        # adopted router member); an explicit larger --replicas tops up with
+        # locally-spawned socket children
+        args.replicas = max(args.replicas, len(args.replica_endpoint))
+    if (args.host_replicas or args.replica_endpoint) \
+            and (args.bench_paged or args.obs_ab):
         ap.error("--bench-paged/--obs-ab measure the single-scheduler hot "
-                 "path; drop --host-replicas")
+                 "path; drop --host-replicas/--replica-endpoint")
     if args.autoscale and args.max_replicas < args.min_replicas:
         ap.error("--max-replicas must be >= --min-replicas")
     if args.autoscale and args.replicas > args.max_replicas:
@@ -1206,13 +1260,21 @@ def main(argv=None) -> int:
         monitor = MonitorMaster(MonitorConfig(jsonl_monitor={
             "enabled": True, "output_path": args.jsonl_metrics,
             "job_name": "loadgen"}))
-    if (args.bench_paged or args.bench_autoscale or args.bench_hosts) \
+    if (args.bench_paged or args.bench_autoscale or args.bench_hosts
+            or args.bench_net) \
             and (args.flight_out or args.trace_out):
         # these lanes dispatch before the tracer/flight wiring: refusing
         # beats silently writing no bundle the caller asked for
-        ap.error("--bench-paged/--bench-autoscale/--bench-hosts manage "
-                 "their own runs; --trace-out/--flight-out are single-run "
-                 "options")
+        ap.error("--bench-paged/--bench-autoscale/--bench-hosts/--bench-net "
+                 "manage their own runs; --trace-out/--flight-out are "
+                 "single-run options")
+    if args.bench_net:
+        # the bench pins its own geometry + fleets (stdio AND socket)
+        if args.bench_paged or args.bench_autoscale or args.obs_ab \
+                or args.bench_hosts:
+            ap.error("--bench-net is its own acceptance run; drop the "
+                     "other bench flags")
+        return _run_net_bench(args, monitor)
     if args.bench_hosts:
         # the bench pins its own geometry + arrival shape (self-calibrated)
         if args.bench_paged or args.bench_autoscale or args.obs_ab:
@@ -1361,6 +1423,269 @@ def _overlap_seconds(lanes):
         depth += d
         last_t = t
     return overlap / 1e6
+
+
+def _run_net_bench(args, monitor) -> int:
+    """Socket-transport acceptance A/B (``BENCH_NET`` JSON).
+
+    Four lanes over REAL child processes, the socket lanes carrying protocol
+    v1 in CRC-framed TCP (``serving.net``) instead of the stdio pipe:
+
+    - **throughput A/B** — the same saturating closed-loop burst over a
+      2-host stdio fleet and a 2-host socket fleet (identical geometry,
+      equal replica count): the gate is socket throughput >= 0.9x stdio —
+      framing + CRC + the io thread must not tax the serving hot path —
+      with the coordinated-omission-honest TTFT-e2e p95 of both lanes
+      reported beside it;
+    - **soak** — 3 socket hosts under traffic with a real mid-decode
+      ``SIGKILL`` (respawn + fresh dial), a ``net:partition`` long enough
+      to trip LIVE→SUSPECT→DEAD (the router evicts and retries elsewhere;
+      the link itself recovers when the fault expires), and a ``net:delay``
+      jitter window: ``lost == 0``, every retried request bit-identical to
+      an unkilled reference ``generate``, every chaos event fires, the
+      supervisor respawns the killed child, and both disturbed replicas
+      return LIVE;
+    - **sever-resume probe** — after the storm, cut one LIVE replica's
+      connection outright: the reconnect machine must redial and RESUME the
+      same child session (token match, no respawn), and the fleet must
+      serve through it again;
+    - **delay no-false-kill** — a 2-host socket fleet under a ``net:delay``
+      jitter window below the SUSPECT threshold: nothing may die — zero
+      evictions, zero restarts, every replica LIVE at the end.
+
+    ``--smoke`` trims request counts only (every lane runs in both forms);
+    the committed artifact is a full run.
+    """
+    import copy
+    from deepspeed_tpu.inference.serving import (ChaosSchedule,
+                                                 QueueFullError, ReplicaState,
+                                                 parse_chaos)
+    args = copy.copy(args)
+    smoke = bool(args.smoke)
+    args.host_replicas = True
+    args.replica_endpoint = None
+    args.prefix_pool, args.prefix_cache = 0, False
+    args.verify_parity = False
+    args.autoscale = False
+    args.schedule_windows, args.deadline_s = None, None
+    args.arrival = "poisson"
+    args.vocab_size, args.max_seq_len = 96, 64
+    args.n_embd, args.n_layer, args.n_head = 32, 2, 4
+    args.slots, args.chunk_size = 1, 2
+    args.min_prompt, args.max_prompt = 3, 6
+    args.min_new, args.max_new = (8, 14) if smoke else (16, 24)
+    args.max_queue = 64
+    args.restart_backoff = 0.3
+    args.kv_pool, args.kv_page_size = "paged", None
+    args.chunk_deadline = None
+    args.smoke = True     # _build_router: hosted-loose health thresholds
+
+    def drive(host, handles, timeout=120.0):
+        t0 = time.monotonic()
+        while any(not h.done for h in handles) \
+                and time.monotonic() - t0 < timeout:
+            host.step()
+        return [h.done for h in handles]
+
+    def warm(hosts, n=2):
+        rng = np.random.default_rng(7)
+        for h in hosts:
+            hs = []
+            for _ in range(n):
+                hs.append(h.submit(
+                    rng.integers(0, args.vocab_size, size=args.max_prompt
+                                 ).astype(np.int32),
+                    max_new_tokens=args.min_new))
+                drive(h, hs)
+
+    # ------------------------------------------------- throughput A/B lanes
+    ab = {}
+    for lane, transport in (("stdio", "stdio"), ("socket", "socket")):
+        print(f"[bench-net] spawning 2 {lane} hosts (throughput lane)...",
+              file=sys.stderr)
+        hosts = spawn_hosts(args, 2, transport=transport)
+        warm(hosts)
+        a = copy.copy(args)
+        a.requests = 16 if smoke else 48
+        a.rate = 1000.0               # saturating: throughput, not arrival
+        front, _, supervisor = _build_router(a, None, monitor, n_static=2,
+                                             host_pool=hosts)
+        snap = run_load(front, a, supervisor=supervisor)
+        close_hosts(front)
+        ab[lane] = snap
+        print(f"[bench-net] {lane}: {snap['tokens_per_sec']:.1f} tok/s "
+              f"ttft_e2e_p95={snap.get('ttft_e2e_ms_p95')}", file=sys.stderr)
+    ratio = (ab["socket"]["tokens_per_sec"] / ab["stdio"]["tokens_per_sec"]
+             if ab["stdio"]["tokens_per_sec"] else None)
+
+    # ----------------------------------------------------------- soak lane
+    print("[bench-net] spawning 3 socket hosts (partition+delay+SIGKILL "
+          "soak)...", file=sys.stderr)
+    hosts = spawn_hosts(args, 3, transport="socket")
+    warm(hosts)
+    a = copy.copy(args)
+    a.requests = 18 if smoke else 48
+    a.rate = 50.0
+    a.min_new, a.max_new = 16, 24
+    spec = ("kill:replica=0,sig=KILL,when=busy;"
+            "net:replica=1,mode=partition,at=0.4,s=2.5;"
+            "net:replica=2,mode=delay=40,at=0.6,s=1.5")
+    chaos = ChaosSchedule(parse_chaos(spec))
+    front, _, supervisor = _build_router(a, None, monitor, n_static=3,
+                                         host_pool=hosts)
+    # the partition must outlive dead_after (DEAD fires mid-fault) and the
+    # bench proves the probe path, not the production recovery window
+    front.config.suspect_after_s, front.config.dead_after_s = 0.5, 1.5
+    front.config.recover_after_s, front.config.max_attempts = 2.0, 4
+    soak = run_load(front, a, chaos=chaos, supervisor=supervisor)
+    # post-storm: keep supervising until BOTH disturbed replicas are re-
+    # admitted (probe bursts — dispatch prefers LIVE replicas, so only
+    # overflow reaches a half-open one)
+    rng = np.random.default_rng(11)
+    t0 = time.monotonic()
+    probes = []
+    while time.monotonic() - t0 < 90.0:
+        supervisor.step()
+        front.step()
+        if all(front.replica_state(i) == ReplicaState.LIVE
+               for i in (0, 1)):
+            break
+        for i in (0, 1):
+            ri = front.replica_by_id(i)
+            if (front.replica_state(i) == ReplicaState.RECOVERING
+                    and ri is not None and ri.available > 0
+                    and front.queue_depth == 0 and len(probes) < 96):
+                try:
+                    for _ in range(args.slots * 3 + 2):
+                        probes.append(front.submit(
+                            rng.integers(0, args.vocab_size,
+                                         size=4).astype(np.int32),
+                            max_new_tokens=6))
+                except QueueFullError:
+                    pass
+    while front.busy and time.monotonic() - t0 < 120.0:
+        supervisor.step()
+        front.step()
+    soak["killed_back_live"] = \
+        front.replica_state(0) == ReplicaState.LIVE
+    soak["partitioned_back_live"] = \
+        front.replica_state(1) == ReplicaState.LIVE
+    soak["hosts"] = supervisor.report()
+    print(f"[bench-net] soak: lost={soak['lost']} "
+          f"parity={soak.get('parity_ok')} "
+          f"restarts={soak['hosts']['restarts_total']} "
+          f"killed_live={soak['killed_back_live']} "
+          f"partitioned_live={soak['partitioned_back_live']}",
+          file=sys.stderr)
+
+    # -------------------------------------------------- sever-resume probe
+    sever = {"resumed": False, "reconnects": 0, "served_after": False}
+    r2 = front.replica_by_id(2)
+    if r2 is not None and getattr(r2, "is_socket", False):
+        session0 = r2.session
+        r2.force_sever("bench-resume-probe")
+        t0 = time.monotonic()
+        # resumed_last resets to None at sever and only the NEXT hello's
+        # ready re-stamps it — wait for the verdict, not just the TCP connect
+        # (reconnects increments before the hello answer lands)
+        while time.monotonic() - t0 < 15.0 \
+                and (r2.severed or r2.reconnects < 1
+                     or r2.resumed_last is None):
+            supervisor.step()
+            front.step()
+        sever["reconnects"] = r2.reconnects
+        sever["resumed"] = bool(r2.resumed_last and r2.session == session0)
+        if not r2.severed:
+            try:
+                h = r2.submit(rng.integers(0, args.vocab_size,
+                                           size=4).astype(np.int32),
+                              max_new_tokens=6)
+                drive(r2, [h], timeout=30.0)
+                sever["served_after"] = bool(h.done)
+            except QueueFullError:
+                pass
+    close_hosts(front)
+    print(f"[bench-net] sever-resume: reconnects={sever['reconnects']} "
+          f"resumed={sever['resumed']} served={sever['served_after']}",
+          file=sys.stderr)
+
+    # ------------------------------------------------ delay no-false-kill
+    print("[bench-net] spawning 2 socket hosts (delay no-false-kill)...",
+          file=sys.stderr)
+    hosts = spawn_hosts(args, 2, transport="socket")
+    warm(hosts)
+    a = copy.copy(args)
+    a.requests = 12 if smoke else 32
+    a.rate = 20.0
+    chaos = ChaosSchedule(parse_chaos(
+        "net:replica=1,mode=delay=30,at=0.3,s=1.5"))
+    front, _, supervisor = _build_router(a, None, monitor, n_static=2,
+                                         host_pool=hosts)
+    front.config.suspect_after_s, front.config.dead_after_s = 0.5, 1.5
+    delay = run_load(front, a, chaos=chaos, supervisor=supervisor)
+    delay["hosts"] = supervisor.report()
+    delay["replica_health"] = {
+        i: front.replica_state(i).value for i in (0, 1)}
+    close_hosts(front)
+    print(f"[bench-net] delay: lost={delay['lost']} "
+          f"evicted={delay['evicted']} "
+          f"restarts={delay['hosts']['restarts_total']} "
+          f"health={delay['replica_health']}", file=sys.stderr)
+
+    gates = {
+        "harness_note": "socket lanes carry protocol v1 in CRC-framed TCP "
+                        "(serving.net); stdio lanes are the PR 15 pipe — "
+                        "same children, same geometry, equal replica count",
+        "stdio_tokens_per_sec": ab["stdio"]["tokens_per_sec"],
+        "socket_tokens_per_sec": ab["socket"]["tokens_per_sec"],
+        "socket_over_stdio": ratio,
+        "socket_holds_0p9x": bool(ratio is not None and ratio >= 0.9),
+        "stdio_ttft_e2e_ms_p95": ab["stdio"].get("ttft_e2e_ms_p95"),
+        "socket_ttft_e2e_ms_p95": ab["socket"].get("ttft_e2e_ms_p95"),
+        "soak_lost": soak["lost"],
+        "soak_chaos_exhausted": soak.get("chaos_exhausted", False),
+        "soak_chaos_unfired": soak.get("chaos_unfired", []),
+        "soak_parity_ok": soak.get("parity_ok", True),
+        "soak_restarts": soak["hosts"]["restarts_total"],
+        "respawn_with_redial": soak["hosts"]["restarts_total"] >= 1,
+        # the respawn-vs-redial split, negatively: the PARTITIONED child's
+        # process never died, so the supervisor must not have respawned it —
+        # its recovery was connection-level (sever-evict-redial)
+        "partition_no_respawn": (
+            soak["hosts"]["replicas"].get(1, {}).get("restarts", 0) == 0),
+        "killed_back_live": soak["killed_back_live"],
+        "partitioned_back_live": soak["partitioned_back_live"],
+        "soak_ok": bool(soak["lost"] == 0
+                        and soak.get("chaos_exhausted", False)
+                        and soak.get("parity_ok", True)
+                        and soak["hosts"]["restarts_total"] >= 1
+                        and soak["killed_back_live"]
+                        and soak["partitioned_back_live"]),
+        "sever_resumed_session": sever["resumed"],
+        "sever_served_after": sever["served_after"],
+        "delay_lost": delay["lost"],
+        "delay_evicted": delay["evicted"],
+        "delay_restarts": delay["hosts"]["restarts_total"],
+        "delay_no_false_kill": bool(
+            delay["lost"] == 0 and delay["evicted"] == 0
+            and delay["hosts"]["restarts_total"] == 0
+            and all(v == "live"
+                    for v in delay["replica_health"].values())),
+    }
+    checks = ["socket_holds_0p9x", "soak_ok", "partition_no_respawn",
+              "sever_resumed_session", "sever_served_after",
+              "delay_no_false_kill"]
+    ok = all(bool(gates[k]) for k in checks)
+    out = {"metric": "socket_over_stdio_throughput",
+           "value": ratio, "unit": "x", "smoke": smoke,
+           "net_gates": gates, "gates_ok": ok,
+           "detail": {"ab": ab, "soak": soak, "sever_resume": sever,
+                      "delay": delay}}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if ok else 1
 
 
 def _run_hosts_bench(args, monitor) -> int:

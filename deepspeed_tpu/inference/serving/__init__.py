@@ -31,6 +31,11 @@ Layers (bottom-up):
   child-stamped heartbeats, real-signal chaos, bounded-backoff respawn
   through the router's RECOVERING warm probe) so replica count finally buys
   machine parallelism;
+- :mod:`net` — :class:`SocketHostedReplica` over a length-prefixed framed
+  TCP transport carrying the same protocol v1 (per-frame CRC + quarantine/
+  resync, versioned hello with session tokens, reconnect state machine with
+  sever-evict-redial semantics, network chaos seam) — the fleet's recovery
+  semantics made transport-independent;
 - :mod:`autoscale` — :class:`Autoscaler` + :class:`ServiceTimeEstimator`: the
   elastic control plane — live metrics (queue depth, recent TTFT p95,
   occupancy) drive replica count with hysteresis + cooldown, and the online
@@ -47,7 +52,8 @@ from .autoscale import (Autoscaler, AutoscaleConfig, EstimatorConfig,
                         ServiceTimeEstimator)
 from .chaos import ChaosEvent, ChaosSchedule, parse_chaos
 from .host import (HostConfig, HostedReplica, ReplicaSupervisor,
-                   SupervisorConfig)
+                   SocketHostedReplica, SupervisorConfig)
+from .net import FrameDecoder, NetConfig, SocketReplicaLink, encode_frame
 from .executor import ChunkedDecodeExecutor, ChunkTimeoutError
 from .kv_pool import PagedKVPool, SlotKVPool
 from .prefix_cache import PrefixCache, PrefixCacheConfig
@@ -70,4 +76,6 @@ __all__ = [
     "Autoscaler", "AutoscaleConfig", "EstimatorConfig", "ServiceTimeEstimator",
     "AdmissionShedError", "AdmissionDeferredError", "DegradationRung",
     "HostConfig", "HostedReplica", "ReplicaSupervisor", "SupervisorConfig",
+    "SocketHostedReplica", "SocketReplicaLink", "NetConfig", "FrameDecoder",
+    "encode_frame",
 ]

@@ -34,6 +34,23 @@ semicolon-separated events, each ``kind:key=val,...``:
                                    # rate by 4x for 2s starting at t=1.0 (the
                                    # loadgen consults load_multiplier(); no
                                    # replica action)
+    net:replica=1,mode=partition,at=1.0,s=2.0
+                                   # NETWORK faults (socket-hosted replicas
+                                   # only — the transport seam must exist, or
+                                   # the event raises rather than letting the
+                                   # soak pass vacuously):
+                                   #   mode=partition — silence both ways for
+                                   #     s seconds (heartbeats freeze -> the
+                                   #     replica ages SUSPECT->DEAD -> the
+                                   #     supervisor respawns or the link
+                                   #     redials);
+                                   #   mode=delay=<ms> — every read delayed
+                                   #     by <ms> (heartbeat jitter: below the
+                                   #     SUSPECT threshold it must NOT
+                                   #     false-kill);
+                                   #   mode=drop=<p> — each read corrupted
+                                   #     with probability p (frame CRC
+                                   #     quarantine + resync under load)
 
 Events fire at most once. ``at`` is seconds since :class:`ChaosSchedule` start;
 ``when=busy`` fires on the first poll where the target replica has a running
@@ -64,19 +81,24 @@ from typing import List, Optional
 
 from ...utils.logging import logger
 
-KINDS = ("kill", "stall", "revive", "surge")
+KINDS = ("kill", "stall", "revive", "surge", "net")
+
+#: net fault modes and whether each carries an embedded value (mode=delay=80)
+NET_MODES = {"partition": False, "delay": True, "drop": True}
 
 
 @dataclass
 class ChaosEvent:
-    kind: str                       # kill | stall | revive | surge
+    kind: str                       # kill | stall | revive | surge | net
     replica: int = 0
     at: Optional[float] = None      # seconds after schedule start
     when: Optional[str] = None      # "busy" | "restore" | "draining"
-    duration: float = 0.5           # stall seconds / surge window seconds
+    duration: float = 0.5           # stall seconds / surge+net window seconds
     mult: float = 2.0               # surge rate multiplier
     sig: Optional[str] = None       # kill only: TERM | KILL — the real signal
     #   a HOSTED replica's child receives (in-process kills stay flag-only)
+    mode: Optional[str] = None      # net only: partition | delay | drop
+    value: float = 0.0              # net only: delay ms / drop probability
     fired: bool = False
     armed: bool = False             # when=restore: hook installed, not yet hit
 
@@ -92,6 +114,22 @@ class ChaosEvent:
             if self.sig not in ("TERM", "KILL"):
                 raise ValueError(f"unknown kill signal sig={self.sig!r} "
                                  "(expected TERM or KILL)")
+        if self.mode is not None and self.kind != "net":
+            raise ValueError("mode= is a net-only field "
+                             f"(got it on {self.kind!r})")
+        if self.kind == "net":
+            if self.mode is None:
+                raise ValueError("chaos net needs mode=partition|"
+                                 "delay=<ms>|drop=<p>")
+            if self.mode not in NET_MODES:
+                raise ValueError(f"unknown net fault mode {self.mode!r} "
+                                 f"(expected one of {tuple(NET_MODES)})")
+            if self.mode == "delay" and self.value <= 0:
+                raise ValueError("chaos net mode=delay=<ms> needs a positive "
+                                 "millisecond value")
+            if self.mode == "drop" and not (0.0 < self.value <= 1.0):
+                raise ValueError("chaos net mode=drop=<p> needs a "
+                                 "probability in (0, 1]")
         if self.kind == "surge":
             if self.at is None:
                 raise ValueError("chaos surge needs at=<s>")
@@ -129,12 +167,23 @@ def parse_chaos(spec: str) -> List[ChaosEvent]:
                 raise ValueError(f"malformed chaos field {item!r} in {part!r}")
             k, _, v = item.partition("=")
             kv[k.strip()] = v.strip()
+        # net mode may embed its value: the field split partitions on the
+        # FIRST '=', so "mode=delay=80" parses to kv["mode"] == "delay=80"
+        mode, value = kv.get("mode"), 0.0
+        if mode is not None and "=" in mode:
+            mode, _, raw_value = mode.partition("=")
+            try:
+                value = float(raw_value)
+            except ValueError:
+                raise ValueError(f"malformed net fault value in "
+                                 f"mode={kv['mode']!r}")
         events.append(ChaosEvent(
             kind=kind.strip(),
             replica=int(kv.get("replica", 0)),
             at=float(kv["at"]) if "at" in kv else None,
             when=kv.get("when"),
             sig=kv.get("sig"),
+            mode=mode, value=value,
             mult=float(kv.get("mult", 2.0)),
             duration=float(kv.get("s", kv.get("duration", 0.5)))))
     return events
@@ -233,6 +282,15 @@ class ChaosSchedule:
                 continue
             if not self._due(ev, router, replica, now):
                 continue
+            if ev.kind == "net" and not hasattr(replica, "net_fault"):
+                # the transport seam must exist (socket-hosted replicas): a
+                # net fault silently skipped would let the soak pass
+                # vacuously — "a chaos run must never degrade to nothing"
+                raise ValueError(
+                    f"chaos net targets replica {ev.replica} but it has no "
+                    "network transport seam — net: faults require a "
+                    "socket-hosted replica (SocketHostedReplica / "
+                    "--replica-endpoint)")
             ev.fired = True
             if ev.kind == "kill":
                 if getattr(replica, "is_hosted", False):
@@ -247,10 +305,13 @@ class ChaosSchedule:
                 # hosted replicas route this to a real SIGSTOP/SIGCONT via
                 # their executor view; in-process wedge the next chunk
                 replica.scheduler.executor.stall_next(ev.duration)
+            elif ev.kind == "net":
+                replica.net_fault(ev.mode, ev.value, ev.duration)
             logger.warning(f"[chaos] {ev.kind} replica {ev.replica}"
                            + (f" sig={ev.sig}" if ev.sig else "")
-                           + (f" ({ev.duration}s)" if ev.kind == "stall"
-                              else "")
+                           + (f" mode={ev.mode}" if ev.mode else "")
+                           + (f" ({ev.duration}s)"
+                              if ev.kind in ("stall", "net") else "")
                            + (" (mid-retire)" if ev.when == "draining"
                               else ""))
             applied.append(ev)

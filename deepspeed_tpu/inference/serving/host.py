@@ -95,13 +95,35 @@ class HostConfig:
     env: Optional[Dict[str, str]] = None
     cmd_override: Optional[List[str]] = None   # tests: replace the child argv
     #   (protocol/supervision lanes run against stub children, no jax import)
+    # ---------------------------------------- per-child serving knobs (PR 16)
+    # these cross the spawn as child argv — the parent-side refusal to
+    # combine --prefix-cache with --host-replicas is lifted: each child owns
+    # its cache/pool and reports hit-rate economics in its heartbeat
+    prefix_cache: bool = False
+    prefix_cache_mb: Optional[float] = None
+    prefix_min_hit: Optional[int] = None
+    kv_pool: Optional[str] = None      # paged | slots (child default: paged)
+    kv_page_size: Optional[int] = None
+    chunk_deadline_s: Optional[float] = None
+    # ----------------------------------------------- socket transport (PR 16)
+    socket_mode: str = "listen"        # SocketHostedReplica spawn wiring:
+    #   "listen" = child binds an ephemeral port, parent dials it;
+    #   "connect" = parent listens, child dials (--connect)
 
     def dims(self) -> Dict:
-        return {"family": self.family, "vocab_size": self.vocab_size,
-                "max_seq_len": self.max_seq_len, "n_embd": self.n_embd,
-                "n_layer": self.n_layer, "n_head": self.n_head,
-                "slots": self.slots, "chunk_size": self.chunk_size,
-                "hb_interval": self.hb_interval_s}
+        d = {"family": self.family, "vocab_size": self.vocab_size,
+             "max_seq_len": self.max_seq_len, "n_embd": self.n_embd,
+             "n_layer": self.n_layer, "n_head": self.n_head,
+             "slots": self.slots, "chunk_size": self.chunk_size,
+             "hb_interval": self.hb_interval_s}
+        for key, val in (("prefix_cache_mb", self.prefix_cache_mb),
+                         ("prefix_min_hit", self.prefix_min_hit),
+                         ("kv_pool", self.kv_pool),
+                         ("kv_page_size", self.kv_page_size),
+                         ("chunk_deadline", self.chunk_deadline_s)):
+            if val is not None:
+                d[key] = val
+        return d
 
 
 def reference_engine(config: HostConfig):
@@ -253,19 +275,25 @@ class _HostSchedulerView:
         return list(self._host._handles.values())
 
     def evict_all(self, reason: str = "evicted") -> List[HostedHandle]:
-        """Whole-replica eviction (breaker death / drain / retire-grace). The
-        child's device state is unrecoverable from the parent (prefix-only
-        recovery), so eviction of a live child = kill; the supervisor owns
-        any respawn. Open handles finalize EVICTED with their streamed
-        prefixes — exactly what the router's requeue absorbs."""
-        self._host.kill(sig="KILL")
-        return self._host._fail_open_handles(reason)
+        """Whole-replica eviction (breaker death / drain / retire-grace) —
+        delegated to the host, whose transport knows whether the process or
+        merely the connection is the casualty."""
+        return self._host.evict_all(reason)
 
     @property
     def prefix_hit_rate(self) -> float:
+        """The child's admission-level hit rate, mirrored off its heartbeat
+        (0.0 while the child's cache is disabled or before the first hb)."""
+        hb = self._host.hb
+        if hb is not None and hb.get("prefix_hit_rate") is not None:
+            return float(hb["prefix_hit_rate"])
         return 0.0
 
     def prefix_cache_report(self) -> Dict:
+        hb = self._host.hb
+        if hb is not None and hb.get("prefix_hit_rate") is not None:
+            return {"enabled": True, "child": True,
+                    "hit_rate": float(hb["prefix_hit_rate"])}
         return {"enabled": False}
 
 
@@ -312,6 +340,7 @@ class HostedReplica:
         cfg = self.config
         self._rep = SubprocessReplica(
             cfg.repo_root or _default_repo_root(), env=cfg.env,
+            prefix_cache=cfg.prefix_cache,
             cmd=list(cfg.cmd_override) if cfg.cmd_override else None,
             **(cfg.dims() if cfg.cmd_override is None else {}))
         self._killed = False
@@ -366,6 +395,15 @@ class HostedReplica:
             except Exception:
                 pass
         self._killed = True
+
+    def evict_all(self, reason: str = "evicted") -> List["HostedHandle"]:
+        """Whole-replica eviction (breaker death / drain / retire-grace). The
+        child's device state is unrecoverable from the parent (prefix-only
+        recovery), so eviction of a live child = kill; the supervisor owns
+        any respawn. Open handles finalize EVICTED with their streamed
+        prefixes — exactly what the router's requeue absorbs."""
+        self.kill(sig="KILL")
+        return self._fail_open_handles(reason)
 
     def stall(self, seconds: float) -> None:
         """Wedge the child with SIGSTOP for ``seconds`` (SIGCONT after): its
@@ -456,11 +494,19 @@ class HostedReplica:
         h = HostedHandle(self, rid, prompt, max_new, eos_token_id, deadline_s,
                          seed)
         self._handles[rid] = h
-        self._rep.submit(
-            rid, prompt, max_new_tokens=max_new, seed=seed,
-            eos_token_id=eos_token_id, deadline_s=deadline_s,
-            trace_id=trace_ctx.trace_id if trace_ctx is not None else None,
-            parent_span=trace_ctx.span_id if trace_ctx is not None else None)
+        try:
+            self._rep.submit(
+                rid, prompt, max_new_tokens=max_new, seed=seed,
+                eos_token_id=eos_token_id, deadline_s=deadline_s,
+                trace_id=trace_ctx.trace_id if trace_ctx is not None else None,
+                parent_span=trace_ctx.span_id if trace_ctx is not None
+                else None)
+        except QueueFullError:
+            # write-side backpressure (socket link's bounded out-buffer): the
+            # request never left the parent — drop the handle, let the
+            # router's admission backpressure absorb it
+            del self._handles[rid]
+            raise
         return h
 
     def step(self, now: Optional[float] = None) -> bool:
@@ -535,12 +581,18 @@ class HostedReplica:
                     h.tpot = (now - h.first_token_at) / (len(h.tokens) - 1)
                 del self._handles[rid]
 
-    def _fail_open_handles(self, reason: str) -> List[HostedHandle]:
+    def _fail_open_handles(self, reason: str,
+                           only: Optional[List[int]] = None
+                           ) -> List[HostedHandle]:
         """Finalize every open handle EVICTED with its streamed prefix (the
-        router's requeue path absorbs exactly these tokens)."""
+        router's requeue path absorbs exactly these tokens). ``only`` limits
+        the sweep to specific request ids (the socket link's per-sever-epoch
+        eviction)."""
         now = time.monotonic()
         out = []
         for rid, h in list(self._handles.items()):
+            if only is not None and rid not in only:
+                continue
             if not h.done:
                 h.state = RequestState.EVICTED
                 h.finish_reason = reason
@@ -605,6 +657,197 @@ class HostedReplica:
         if hb is None or "_rx_t" not in hb:
             return None
         return max(0.0, (hb["_rx_t"] - float(hb["t"])) * 1e3)
+
+
+class SocketHostedReplica(HostedReplica):
+    """A :class:`HostedReplica` whose protocol v1 rides the framed-TCP
+    transport (:mod:`.net`) instead of the stdio pipe — the same recovery
+    semantics across a MACHINE boundary.
+
+    Three wirings (``HostConfig.socket_mode`` + ``endpoint``):
+
+    - ``socket_mode="listen"`` (default): spawn the child with
+      ``--serve-socket --listen 127.0.0.1:0`` and dial the bootstrap port;
+    - ``socket_mode="connect"``: parent listens, child dials
+      (``--connect``) — the wiring for children behind NAT;
+    - ``endpoint="host:port"``: dial an externally started child
+      (``deepspeed-serve --replica-endpoint``); there is no local process,
+      so "kill" means sever + redial and supervision respawns the LINK.
+
+    On a severed connection ``step()`` immediately evicts in-flight requests
+    WITH their streamed prefixes (the checkpointless-retry path — recovery
+    stays bit-exact) while the link's reconnect machine redials with bounded
+    exponential backoff; the frozen heartbeat ages the replica through
+    LIVE→SUSPECT→DEAD exactly like pipe silence. A dead CHILD respawns via
+    the supervisor; a dead CONNECTION redials via the link — the
+    respawn-or-redial split. ``net_fault`` exposes the chaos transport seam
+    (``net:replica=i,mode=partition|delay=<ms>|drop=<p>``)."""
+
+    is_socket = True
+
+    def __init__(self, config: Optional[HostConfig] = None,
+                 replica_id: int = -1, wait_ready: bool = False,
+                 endpoint: Optional[str] = None, net=None):
+        self._endpoint = endpoint
+        self._net = net                # Optional[net.NetConfig]
+        super().__init__(config, replica_id, wait_ready)
+
+    def _spawn(self) -> None:
+        from .net import SocketReplicaLink
+        cfg = self.config
+        if self._rep is not None:
+            self._rep.close()          # release the old link's IO + sockets
+        spawn_args = (cfg.dims()
+                      if cfg.cmd_override is None and self._endpoint is None
+                      else {})
+        self._rep = SocketReplicaLink(
+            cfg.repo_root or _default_repo_root(), env=cfg.env,
+            prefix_cache=cfg.prefix_cache,
+            cmd=list(cfg.cmd_override) if cfg.cmd_override else None,
+            endpoint=self._endpoint,
+            child_dials=(cfg.socket_mode == "connect"),
+            net=self._net, **spawn_args)
+        self._killed = False
+        self._warm = False
+        self._spawned_at = time.monotonic()
+        self.last_heartbeat = self._spawned_at
+
+    # ------------------------------------------------------------------ chaos
+    def kill(self, sig: str = "KILL") -> None:
+        if self._endpoint is not None:
+            # no local process to signal: the connection is the only lever —
+            # sever now (step() evicts with prefixes), let the reconnect
+            # machine redial; the router re-admits through RECOVERING
+            self._cancel_stall()
+            self._rep.force_sever("chaos-kill")
+            return
+        super().kill(sig)
+
+    def stall(self, seconds: float) -> None:
+        if self._endpoint is not None:
+            # SIGSTOP cannot cross the network: a partition window is the
+            # transport-native wedge (silence both ways, then recovery)
+            self._rep.net_fault("partition", 0.0, seconds)
+            return
+        super().stall(seconds)
+
+    def net_fault(self, mode: str, value: float, duration_s: float) -> None:
+        """Chaos transport seam (``net:`` grammar): partition | delay | drop
+        injected at the parent side of the link."""
+        self._rep.net_fault(mode, value, duration_s)
+
+    def force_sever(self, why: str = "forced") -> None:
+        """Cut the connection NOW (the live process keeps running): in-flight
+        work evicts with prefixes on the next step and the reconnect machine
+        redials with the session token — the sever-resume probe the net
+        bench and tests drive directly."""
+        if self._rep is not None:
+            self._rep.force_sever(why)
+
+    def evict_all(self, reason: str = "evicted") -> List["HostedHandle"]:
+        """The respawn-vs-redial split at the breaker: when the CONNECTION is
+        the known casualty (severed, or a net fault in force) and the child
+        process is alive, eviction must not kill the process — open handles
+        finalize EVICTED with prefixes, the link severs so the reconnect
+        machine redials with the session token, and the re-hello's
+        ``cancel_all`` frees the child's orphaned slots. Anything else (true
+        heartbeat wedge, drain, retire-grace) keeps the kill semantics: a
+        child the parent cannot trust is replaced, not reasoned with."""
+        rep = self._rep
+        if rep is not None and self.alive \
+                and (rep.severed or rep.fault_active):
+            if not rep.severed:
+                rep.force_sever(f"breaker-evict ({reason})")
+            return self._fail_open_handles(reason)
+        return super().evict_all(reason)
+
+    # ------------------------------------------------------------------- pump
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None, seed: int = 0,
+               trace_ctx=None) -> HostedHandle:
+        # stamp the link's sever epoch BEFORE the wire enqueue: a sever that
+        # races the enqueue leaves the handle in the old epoch and step()
+        # evicts it (the frame may never have left this side), while a handle
+        # minted after a quick redial is never swept by the stale sever
+        epoch = self._rep.sever_count if self._rep is not None else 0
+        h = super().submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_token_id=eos_token_id, deadline_s=deadline_s,
+                           seed=seed, trace_ctx=trace_ctx)
+        h.sever_epoch = epoch
+        return h
+
+    def step(self, now: Optional[float] = None) -> bool:
+        rep = self._rep
+        if rep is not None and not self._stopped and self._handles:
+            # sever eviction: whatever was in flight on a severed connection
+            # finalizes EVICTED with its streamed prefix — the router's
+            # checkpointless retry re-prefills prompt+prefix elsewhere,
+            # bit-exact, while the link redials in the background. Keyed on
+            # the per-handle sever EPOCH, not the live ``severed`` flag: the
+            # IO thread can win the redial race between two parent steps, and
+            # the resumed hello's cancel_all would then turn the guaranteed
+            # eviction into a child-side cancel. Runs BEFORE the harvest so a
+            # post-resume cancelled terminal never beats the eviction; the
+            # streamed prefix is folded in here from the same progress lines
+            # the harvest would have read.
+            count = rep.sever_count
+            tnow = time.monotonic()
+            stale = []
+            for rid, h in list(self._handles.items()):
+                if getattr(h, "sever_epoch", 0) >= count or h.done:
+                    continue
+                line = rep.progress.get(rid) or {}
+                toks = line.get("tokens") or []
+                if len(toks) > len(h.tokens):
+                    if h.first_token_at is None:
+                        h.first_token_at = tnow
+                        h.ttft = tnow - h.arrival
+                        h.prefix_hit_tokens = int(
+                            line.get("prefix_hit_tokens") or 0)
+                    self._tokens_total += len(toks) - len(h.tokens)
+                    h.tokens = [int(t) for t in toks]
+                    self._warm = True
+                if h._cancel or (line.get("done")
+                                 and line.get("state") != "cancelled"):
+                    # a real terminal (finished/expired, flushed before the
+                    # sever) or a parent-initiated cancel: the harvest applies
+                    # it — only in-flight casualties evict
+                    continue
+                stale.append(rid)
+            if stale:
+                self._fail_open_handles("severed", only=stale)
+        return super().step(now)
+
+    @property
+    def available(self) -> int:
+        rep = self._rep
+        if rep is not None and rep.severed:
+            return 0                   # no dispatch into a severed link
+        return super().available
+
+    # ---------------------------------------------------------------- surface
+    @property
+    def severed(self) -> bool:
+        return bool(self._rep is not None and self._rep.severed)
+
+    @property
+    def reconnects(self) -> int:
+        return self._rep.reconnects if self._rep is not None else 0
+
+    @property
+    def session(self) -> Optional[str]:
+        return self._rep.session if self._rep is not None else None
+
+    @property
+    def resumed_last(self) -> Optional[bool]:
+        """Whether the link's most recent hello resumed the child's prior
+        session (vs a fresh one after a child restart); ``None`` while
+        severed — the verdict belongs to the NEXT hello."""
+        return self._rep.resumed_last if self._rep is not None else None
+
+    def rtt_ms(self) -> Optional[float]:
+        return self._rep.rtt_last_ms if self._rep is not None else None
 
 
 @dataclass

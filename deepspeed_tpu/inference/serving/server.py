@@ -131,8 +131,16 @@ def make_status_provider(front, autoscaler=None, recorder=None,
                      "running": r.running,
                      "queued": r.queued,
                      "retiring": front.health[r.id].retiring,
-                     **({"pid": r.child_pid, "restarts": r.restarts}
-                        if getattr(r, "is_hosted", False) else {})}
+                     **({"pid": r.child_pid, "restarts": r.restarts,
+                         "prefix_hit_rate": (
+                             r.scheduler.prefix_hit_rate
+                             if r.scheduler.prefix_cache_report().get(
+                                 "child") else None)}
+                        if getattr(r, "is_hosted", False) else {}),
+                     **({"severed": r.severed,
+                         "reconnects": r.reconnects,
+                         "rtt_ms": r.rtt_ms()}
+                        if getattr(r, "is_socket", False) else {})}
                     for r in front.replicas],
                 "retired_replicas": list(front.retired),
                 "counters": {
@@ -424,6 +432,22 @@ def main(argv=None) -> int:
                          "stalls deliver real SIGKILL/SIGSTOP, and a "
                          "ReplicaSupervisor respawns dead children with "
                          "exponential backoff under --max-restarts")
+    ap.add_argument("--host-transport", default="stdio",
+                    choices=("stdio", "socket"),
+                    help="hosted-replica transport: 'stdio' (default) = "
+                         "JSONL over the child's stdin/stdout pipe; "
+                         "'socket' = the same protocol v1 carried in "
+                         "length-prefixed CRC-framed TCP (serving.net) with "
+                         "session-token redial, so a severed connection "
+                         "evicts-and-retries instead of killing the child")
+    ap.add_argument("--replica-endpoint", action="append", default=None,
+                    metavar="HOST:PORT",
+                    help="adopt an ALREADY-RUNNING socket replica child "
+                         "(started with --serve-socket --listen) at this "
+                         "address instead of spawning one; repeatable — each "
+                         "endpoint becomes one router member. Implies the "
+                         "hosted-router path; geometry flags must match the "
+                         "remote child's")
     ap.add_argument("--max-restarts", type=int, default=3,
                     help="per-replica child respawn budget (hosted replicas; "
                          "exhausted -> pinned DEAD, survivors keep serving)")
@@ -575,7 +599,7 @@ def main(argv=None) -> int:
     # SLO admission lives on the Router: a bare --slo-admission must not
     # silently degrade to the admission-blind single-scheduler path
     if args.replicas > 1 or args.autoscale or args.slo_admission \
-            or args.host_replicas:
+            or args.host_replicas or args.replica_endpoint:
         from .autoscale import Autoscaler, AutoscaleConfig
         from .chaos import ChaosSchedule, parse_chaos
         from .router import Router, RouterConfig
@@ -588,9 +612,9 @@ def main(argv=None) -> int:
               else args.replicas)
         rcfg = RouterConfig(serving=serving_cfg, max_queue=args.max_queue,
                             slo_admission=args.slo_admission)
-        if args.host_replicas:
+        if args.host_replicas or args.replica_endpoint:
             from .host import (HostConfig, HostedReplica, ReplicaSupervisor,
-                               SupervisorConfig)
+                               SocketHostedReplica, SupervisorConfig)
             if args.checkpoint:
                 raise SystemExit("--host-replicas serves the deterministic-"
                                  "init model; --checkpoint does not cross "
@@ -599,25 +623,38 @@ def main(argv=None) -> int:
                 raise SystemExit("--host-replicas children build float32 "
                                  "tp=1 engines (the determinism contract "
                                  "behind bit-exact retry parity)")
-            if args.prefix_cache or args.kv_pool != "paged" \
-                    or args.chunk_deadline is not None:
-                # refuse rather than silently serve without the protection/
-                # optimization the operator asked for: these knobs configure
-                # the CHILD's scheduler and are not wired over the pipe yet
-                raise SystemExit(
-                    "--host-replicas children manage their own serving "
-                    "config; --prefix-cache/--kv-pool/--chunk-deadline do "
-                    "not cross the pipe (ROADMAP: HostConfig knobs)")
+            # serving knobs cross the pipe as child argv (HostConfig.dims):
+            # each child builds its own prefix cache / paged pool / watchdog
             hcfg = HostConfig(
                 family=args.family, vocab_size=args.vocab_size,
                 max_seq_len=args.max_seq_len, n_embd=args.n_embd,
                 n_layer=args.n_layer, n_head=args.n_head, slots=args.slots,
-                chunk_size=args.chunk_size)
-            members = [HostedReplica(hcfg) for _ in range(n0)]
+                chunk_size=args.chunk_size,
+                prefix_cache=args.prefix_cache,
+                prefix_cache_mb=(args.prefix_cache_mb
+                                 if args.prefix_cache else None),
+                prefix_min_hit=(args.prefix_min_hit
+                                if args.prefix_cache else None),
+                kv_pool=args.kv_pool, kv_page_size=args.kv_page_size,
+                chunk_deadline_s=args.chunk_deadline)
+            if args.replica_endpoint:
+                # adopt running children: the endpoint list IS the fleet
+                members = [SocketHostedReplica(hcfg, endpoint=ep)
+                           for ep in args.replica_endpoint]
+            elif args.host_transport == "socket":
+                members = [SocketHostedReplica(hcfg) for _ in range(n0)]
+            else:
+                members = [HostedReplica(hcfg) for _ in range(n0)]
             for m in members:
                 m.wait_ready()
             engines = None
-            engine_factory = lambda: HostedReplica(hcfg)   # noqa: E731
+            # autoscale grow-by-spawn always spawns locally — even an
+            # endpoint fleet grows with a local socket child, not a dial
+            # to an address nobody is listening on
+            if args.replica_endpoint or args.host_transport == "socket":
+                engine_factory = lambda: SocketHostedReplica(hcfg)  # noqa: E731
+            else:
+                engine_factory = lambda: HostedReplica(hcfg)   # noqa: E731
             if args.selftest:
                 # looser than the in-process selftest: heartbeats ride a
                 # 50ms child stream, and a 0.15s flatline bound would
@@ -637,7 +674,7 @@ def main(argv=None) -> int:
                 rcfg.recover_after_s, rcfg.max_attempts = 30.0, 4
         front = Router(members, rcfg, monitor=monitor)
         front.install_sigterm_drain()      # SIGTERM = graceful drain
-        if args.host_replicas:
+        if args.host_replicas or args.replica_endpoint:
             supervisor = ReplicaSupervisor(front, SupervisorConfig(
                 max_restarts=args.max_restarts,
                 backoff_base_s=args.restart_backoff))

@@ -105,7 +105,34 @@ def child_main(argv=None) -> int:
     ap.add_argument("--chunk-size", type=int, default=2)
     ap.add_argument("--hb-interval", type=float, default=0.05)
     ap.add_argument("--prefix-cache", action="store_true")
+    # per-child serving knobs (HostConfig ships these across the spawn —
+    # PR 16: parent flags now DO cross the pipe instead of being refused)
+    ap.add_argument("--prefix-cache-mb", type=float, default=None)
+    ap.add_argument("--prefix-min-hit", type=int, default=4)
+    ap.add_argument("--kv-pool", default="paged", choices=("paged", "slots"))
+    ap.add_argument("--kv-page-size", type=int, default=None)
+    ap.add_argument("--chunk-deadline", type=float, default=None)
+    # socket transport (net.py): serve protocol v1 over framed TCP instead of
+    # the stdio pipe — --listen "host:port"/"port" (0 = ephemeral, announced
+    # as a {"listening": port} bootstrap line on stdout) or --connect
+    # "host:port" (the child runs the dial/backoff loop)
+    ap.add_argument("--serve-socket", action="store_true")
+    ap.add_argument("--listen", default=None)
+    ap.add_argument("--connect", default=None)
     args = ap.parse_args(argv)
+
+    # protocol v1 state shared with the transport: the socket IO (when
+    # enabled) must exist BEFORE the heavy jax import so the bootstrap line
+    # lands fast and early frames buffer while the engine builds
+    lines: List[str] = []
+    eof = threading.Event()
+    term = threading.Event()        # SIGTERM = graceful drain (ladder rung)
+    signal.signal(signal.SIGTERM, lambda signum, frame: term.set())
+    sock_io = None
+    if args.serve_socket:
+        from .net import ChildSocketIO
+        sock_io = ChildSocketIO(lines=lines, term=term, listen=args.listen,
+                                connect=args.connect)
 
     import jax.numpy as jnp
 
@@ -125,17 +152,29 @@ def child_main(argv=None) -> int:
                dtype=jnp.float32),
         DeepSpeedInferenceConfig(dtype="float32",
                                  max_out_tokens=args.max_seq_len))
-    prefix = PrefixCacheConfig(min_hit_tokens=4, min_insert_tokens=4,
-                               insert_on="prefill") if args.prefix_cache \
-        else None
+    prefix = None
+    if args.prefix_cache:
+        prefix = PrefixCacheConfig(
+            min_hit_tokens=args.prefix_min_hit,
+            min_insert_tokens=args.prefix_min_hit, insert_on="prefill")
+        if args.prefix_cache_mb is not None:
+            prefix.max_bytes = int(args.prefix_cache_mb * 1024 * 1024)
+    page_kw = ({"kv_page_size": args.kv_page_size}
+               if args.kv_page_size is not None else {})
     sched = ContinuousBatchingScheduler(engine, ServingConfig(
         slots=args.slots, chunk_size=args.chunk_size,
-        max_seq_len=args.max_seq_len, prefix_cache=prefix))
+        max_seq_len=args.max_seq_len, prefix_cache=prefix,
+        kv_pool=args.kv_pool, chunk_deadline_s=args.chunk_deadline,
+        **page_kw))
 
     out = sys.stdout
     emit_lock = threading.Lock()
 
     def emit(obj):
+        if sock_io is not None:     # framed TCP transport (net.py)
+            with emit_lock:
+                sock_io.emit(obj)
+            return
         with emit_lock:             # hb thread + main loop share the pipe
             out.write(json.dumps(obj) + "\n")
             out.flush()             # every line visible before any SIGKILL
@@ -165,25 +204,26 @@ def child_main(argv=None) -> int:
                       "queued": sched.queue_depth,
                       "free_slots": int(pool.free_slots),
                       "occupancy": float(pool.occupancy),
-                      "rss_bytes": _rss_bytes()})
+                      "rss_bytes": _rss_bytes(),
+                      # per-child cache economics for the parent's /statusz
+                      # (None = cache disabled in this child)
+                      "prefix_hit_rate": (float(sched.prefix_hit_rate)
+                                          if sched.prefix_cache is not None
+                                          else None)})
             except (BrokenPipeError, ValueError, OSError):
                 return              # parent went away: nothing to report to
             hb_stop.wait(args.hb_interval)
 
     threading.Thread(target=hb_loop, daemon=True).start()
 
-    lines: List[str] = []
-    eof = threading.Event()
-    term = threading.Event()        # SIGTERM = graceful drain (ladder rung 2)
-    signal.signal(signal.SIGTERM, lambda signum, frame: term.set())
+    if sock_io is None:
+        def reader():
+            for line in sys.stdin:
+                if line.strip():
+                    lines.append(line.strip())
+            eof.set()
 
-    def reader():
-        for line in sys.stdin:
-            if line.strip():
-                lines.append(line.strip())
-        eof.set()
-
-    threading.Thread(target=reader, daemon=True).start()
+        threading.Thread(target=reader, daemon=True).start()
     tracer = get_tracer()
     handles: Dict[int, object] = {}
     reported: Dict[int, int] = {}
@@ -204,6 +244,13 @@ def child_main(argv=None) -> int:
             if req.get("cmd") == "cancel":
                 h = handles.get(int(req.get("id", -1)))
                 if h is not None:
+                    h.cancel()
+                continue
+            if req.get("cmd") == "cancel_all":
+                # a fresh socket connection superseded a severed one: the
+                # parent evicted the in-flight work with prefixes, so free
+                # its slots here instead of leaking them to orphans
+                for h in list(handles.values()):
                     h.cancel()
                 continue
             ctx = None
@@ -258,6 +305,8 @@ def child_main(argv=None) -> int:
                 emit({"spans": finished})
     hb_stop.set()
     emit({"summary": sched.telemetry.snapshot()})
+    if sock_io is not None:
+        sock_io.close()
     return 0
 
 

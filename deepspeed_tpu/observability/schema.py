@@ -80,6 +80,17 @@ TAGS: Dict[str, Tuple[str, str]] = {
     "host/child_rss_bytes": (GAUGE, "max child RSS across hosted replicas"),
     "host/pipe_lag_ms": (GAUGE, "max heartbeat pipe transit+age across "
                                 "hosted replicas"),
+    # ------------------------------------------ socket replica transport (PR 16)
+    "net/frames_total": (COUNTER, "wire frames moved (sent + decoded) per "
+                                  "socket link"),
+    "net/reconnects_total": (COUNTER, "successful redials by the reconnect "
+                                      "state machine"),
+    "net/quarantined_frames_total": (COUNTER, "frame-level quarantine "
+                                              "events (bad magic/CRC/length "
+                                              "-> resync)"),
+    "net/partition_trips_total": (COUNTER, "connection severs observed "
+                                           "(RST/FIN/partition aging out)"),
+    "net/rtt_ms": (HISTOGRAM, "ping/pong round-trip per socket link"),
     # ---------------------------------------------------------------- training
     "Train/Samples/train_loss": (GAUGE, "loss at each optimizer step"),
     "Train/Samples/lr": (GAUGE, "learning rate at each optimizer step"),
@@ -178,6 +189,7 @@ EMITTER_MODULES = (
     "deepspeed_tpu/inference/serving/router.py",
     "deepspeed_tpu/inference/serving/autoscale.py",
     "deepspeed_tpu/inference/serving/host.py",
+    "deepspeed_tpu/inference/serving/net.py",
     "deepspeed_tpu/runtime/engine.py",
     "deepspeed_tpu/inference/engine.py",
     "deepspeed_tpu/observability/metrics.py",

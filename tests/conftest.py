@@ -73,6 +73,12 @@ def pytest_configure(config):
         "router membership, ReplicaSupervisor restart storm + budget, "
         "chaos sig= grammar, real SIGKILL+respawn parity) — tier-1 fast "
         "lane; its bench smoke is marked slow")
+    config.addinivalue_line(
+        "markers", "serving_net: socket replica transport lane (frame codec "
+        "roundtrip + CRC quarantine/resync, versioned hello + session "
+        "resume, sever-evict-redial parity, net:* chaos grammar, partition/"
+        "delay soak over real TCP children) — tier-1 fast lane; its bench "
+        "smoke is marked slow")
 
 
 def pytest_collection_modifyitems(config, items):

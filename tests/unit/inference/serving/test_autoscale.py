@@ -512,9 +512,14 @@ def _load_loadgen():
     return mod
 
 
+@pytest.mark.slow
 def test_loadgen_schedule_smoke(capsys):
     """Satellite: piecewise-Poisson schedule arrivals + per-window TTFT/TPOT
-    percentiles + replica-seconds in the BENCH JSON."""
+    percentiles + replica-seconds in the BENCH JSON.
+
+    Slow lane (tier-1 window reclaim): the same loadgen.main schedule path
+    runs in-window via the unit lanes + parse-error test; this end-to-end
+    smoke duplicates it at full boot cost."""
     loadgen = _load_loadgen()
     rc = loadgen.main(["--smoke", "--arrival", "schedule:4@1,20@1,4@1",
                        "--requests", "10"])
@@ -544,9 +549,14 @@ def test_loadgen_schedule_parse_errors():
     assert loadgen.parse_schedule("2@3,10@2") == [(2.0, 3.0), (10.0, 2.0)]
 
 
+@pytest.mark.slow
 def test_loadgen_autoscale_smoke(capsys):
     """End-to-end control loop under a load swing: scales up AND back down,
-    lost == 0, every migrated request bit-exact, autoscale report present."""
+    lost == 0, every migrated request bit-exact, autoscale report present.
+
+    Slow lane (tier-1 window reclaim): the in-window autoscaler unit lanes
+    cover the control loop; the BENCH_AUTOSCALE artifact gates the
+    end-to-end claim."""
     loadgen = _load_loadgen()
     rc = loadgen.main(["--smoke", "--autoscale", "--min-replicas", "1",
                        "--max-replicas", "3",

@@ -184,7 +184,11 @@ def _stub_host(cmd, **cfg):
                                     stop_drain_s=0.2, stop_term_s=0.2, **cfg))
 
 
-def _survivor_engine():
+@pytest.fixture(scope="module")
+def survivor_engine():
+    """One in-process survivor engine shared by the supervisor/statusz lanes
+    (tier-1 window reclaim: three engine builds + XLA warms collapsed into
+    one; every consumer drives disjoint requests or none at all)."""
     import jax.numpy as jnp
 
     import deepspeed_tpu as ds
@@ -197,12 +201,12 @@ def _survivor_engine():
                                               max_out_tokens=48))
 
 
-def test_supervisor_restart_storm_budget_and_survivors():
+def test_supervisor_restart_storm_budget_and_survivors(survivor_engine):
     """The restart-storm lane: a host whose child dies instantly respawns
     with GROWING backoff until the budget exhausts and the replica pins DEAD
     — while the router keeps serving every request on the in-process
     survivor, lost == 0."""
-    engine = _survivor_engine()
+    engine = survivor_engine
     host = _stub_host(INSTANT_EXIT)
     rcfg = RouterConfig(
         serving=ServingConfig(slots=2, chunk_size=3, max_seq_len=48,
@@ -240,12 +244,12 @@ def test_supervisor_restart_storm_budget_and_survivors():
     host.close()
 
 
-def test_supervisor_report_and_statusz_top_surfaces():
+def test_supervisor_report_and_statusz_top_surfaces(survivor_engine):
     """/statusz carries child pid + restart count per hosted replica and the
     supervisor block; ds-tpu-top renders both."""
     from deepspeed_tpu.inference.serving.server import make_status_provider
     from deepspeed_tpu.observability.top import render
-    engine = _survivor_engine()
+    engine = survivor_engine
     host = _stub_host(SLEEPER)
     host.wait_ready()
     router = Router([engine, host], RouterConfig(
@@ -264,9 +268,9 @@ def test_supervisor_report_and_statusz_top_surfaces():
     host.close()
 
 
-def test_detach_closes_hosted_child():
+def test_detach_closes_hosted_child(survivor_engine):
     """Retiring a hosted replica must not leak its child process."""
-    engine = _survivor_engine()
+    engine = survivor_engine
     host = _stub_host(SLEEPER)
     host.wait_ready()
     router = Router([engine, host], RouterConfig(

@@ -556,10 +556,15 @@ def test_kv_page_size_validation():
 
 
 # ------------------------------------------------------------- bench smoke
+@pytest.mark.slow
 def test_bench_paged_smoke(tmp_path, capsys):
     """--bench-paged --smoke: schema + parity/lost gates must hold in-process
     (the throughput ratio is reported but only the committed BENCH artifact
-    gates >= 1.5x — a loaded CI host is not a benchmarking rig)."""
+    gates >= 1.5x — a loaded CI host is not a benchmarking rig).
+
+    Slow lane (tier-1 window reclaim, the PR 15 bench-smoke pattern): the
+    in-window paged_kv unit lanes cover allocator/parity/eviction; the
+    committed BENCH_PAGED artifact gates the A/B."""
     spec = importlib.util.spec_from_file_location(
         "loadgen_pagedbench", os.path.join(REPO, "benchmarks", "serving",
                                            "loadgen.py"))
