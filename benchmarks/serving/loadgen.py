@@ -151,8 +151,20 @@ def make_prompts(args, rng):
     n = args.requests
     sizes = draw_lengths(rng, n, args.min_prompt, args.max_prompt,
                          getattr(args, "prompt_dist", None))
-    tails = [rng.integers(0, args.vocab_size, size=int(s)).astype(np.int32)
-             for s in sizes]
+    if getattr(args, "prompt_style", None) == "repetitive":
+        # speculative-bench trace: each prompt tiles a short random unit, so
+        # its suffix recurs verbatim earlier in the stream — the regime the
+        # self-speculative n-gram proposer exists for (and the shape of
+        # structured/templated real prompts)
+        tails = []
+        for s in sizes:
+            unit = rng.integers(0, args.vocab_size,
+                                size=int(rng.integers(3, 6))).astype(np.int32)
+            reps = -(-int(s) // unit.size)
+            tails.append(np.tile(unit, reps)[:int(s)])
+    else:
+        tails = [rng.integers(0, args.vocab_size,
+                              size=int(s)).astype(np.int32) for s in sizes]
     if not args.prefix_pool:
         return tails, [None] * n
     pool = [rng.integers(0, args.vocab_size, size=args.prefix_len
@@ -1053,6 +1065,12 @@ def main(argv=None) -> int:
                          "HBM budget on a mixed-length trace (sustained "
                          "tok/s) + zero-copy vs scatter-restore prefix-hit "
                          "TTFT; emits BENCH_PAGED JSON with gates")
+    ap.add_argument("--bench-spec", action="store_true",
+                    help="speculative-decoding acceptance A/B: spec-on vs "
+                         "spec-off greedy lanes on a repetitive-suffix trace "
+                         "(every request parity-checked) + a chaos kill lane "
+                         "with speculation on; emits BENCH_SPEC JSON gating "
+                         "passes-per-token and n-gram acceptance")
     ap.add_argument("--vocab-size", type=int, default=512)
     ap.add_argument("--max-seq-len", type=int, default=128)
     ap.add_argument("--n-embd", type=int, default=128)
@@ -1261,13 +1279,13 @@ def main(argv=None) -> int:
             "enabled": True, "output_path": args.jsonl_metrics,
             "job_name": "loadgen"}))
     if (args.bench_paged or args.bench_autoscale or args.bench_hosts
-            or args.bench_net) \
+            or args.bench_net or args.bench_spec) \
             and (args.flight_out or args.trace_out):
         # these lanes dispatch before the tracer/flight wiring: refusing
         # beats silently writing no bundle the caller asked for
-        ap.error("--bench-paged/--bench-autoscale/--bench-hosts/--bench-net "
-                 "manage their own runs; --trace-out/--flight-out are "
-                 "single-run options")
+        ap.error("--bench-paged/--bench-autoscale/--bench-hosts/--bench-net/"
+                 "--bench-spec manage their own runs; --trace-out/"
+                 "--flight-out are single-run options")
     if args.bench_net:
         # the bench pins its own geometry + fleets (stdio AND socket)
         if args.bench_paged or args.bench_autoscale or args.obs_ab \
@@ -1281,6 +1299,16 @@ def main(argv=None) -> int:
             ap.error("--bench-hosts is its own acceptance run; drop the "
                      "other bench flags")
         return _run_hosts_bench(args, monitor)
+    if args.bench_spec:
+        # dispatched before serving_cfg: the bench pins its own geometry,
+        # prompt trace (repetitive-suffix), and per-lane serving configs
+        if args.bench_paged or args.bench_autoscale or args.obs_ab:
+            ap.error("--bench-spec is its own acceptance run; drop the "
+                     "other bench flags")
+        if args.replicas > 1 or args.chaos or args.autoscale:
+            ap.error("--bench-spec manages its own lanes (incl. the chaos "
+                     "one); drop --replicas/--chaos/--autoscale")
+        return _run_spec_bench(args, monitor)
     if args.bench_paged:
         # dispatched before serving_cfg: the bench pins its own per-lane
         # geometries (and --kv-page-size may be None = per-lane default here)
@@ -2094,6 +2122,156 @@ def _run_hosts_bench(args, monitor) -> int:
            "hosts_gates": gates, "gates_ok": ok,
            "detail": {"concurrency": conc, "soak": soak,
                       **({"latency_ab": ab} if ab is not None else {})}}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def _run_spec_bench(args, monitor) -> int:
+    """Speculative-decoding acceptance A/B (``BENCH_SPEC`` JSON).
+
+    Three lanes over ONE tiny engine (shared compile cache — the A/B
+    isolates speculation, not compilation), all greedy with EVERY request
+    parity-checked against per-request ``generate``:
+
+    - **spec-off** — the plain chunked paged decode path (the baseline);
+    - **spec-on** — the same trace with the self-speculative n-gram
+      proposer + one-pass k-token verify. The trace is repetitive-suffix
+      (``prompt_style="repetitive"``: tiled short units — templated/
+      structured prompts), the regime the n-gram draft exists for. Gates:
+      acceptance >= 0.6 and **target passes per committed token <= 0.55**
+      — the verify-round count divided by tokens emitted, i.e. the
+      weight-streaming bytes multiplier speculation exists to shrink
+      (PERF.md's bytes/step model; on a decode-bandwidth-bound chip
+      tok/s tracks its inverse);
+    - **chaos** — a 2-replica router with speculation on and a mid-flight
+      replica kill: the checkpointless-retry contract must hold under
+      speculation (lost == 0, every retried request bit-exact).
+
+    The on/off lanes are order-interleaved per rep and gated on medians so
+    machine drift cancels. Wall-clock tok/s for both lanes rides along in
+    the artifact but is NOT gated: on the CPU host the verify forward is
+    compute-bound (k+1 rows cost ~(k+1)x a single-row step), so the
+    passes-per-token win does not convert to wall-clock here — on a chip
+    the decode step is weight-bandwidth-bound and the conversion is the
+    point (ROADMAP carried item, same family as the paged-gather caveat).
+    """
+    import copy
+    from deepspeed_tpu.inference.serving import (ChaosSchedule,
+                                                 ContinuousBatchingScheduler,
+                                                 Router, RouterConfig,
+                                                 ServingConfig, parse_chaos)
+    geom = dict(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+                cap=64, slots=2, chunk=3, page=8, k=4)
+    if args.smoke:
+        requests, reps, chaos_requests = 10, 2, 6
+    else:
+        requests, reps, chaos_requests = 40, 3, 12
+    a0 = copy.copy(args)
+    for key in ("vocab_size", "max_seq_len", "n_embd", "n_layer", "n_head"):
+        setattr(a0, key, geom[key])
+    a0.rate, a0.verify_parity = 1000.0, True    # saturate: sustained rate
+    a0.requests = requests
+    a0.max_queue = 256
+    a0.prefix_pool, a0.prefix_cache = 0, False
+    a0.prompt_style = "repetitive"
+    a0.min_prompt, a0.max_prompt = 12, 20
+    a0.min_new, a0.max_new = 8, 16
+    a0.prompt_dist = a0.output_dist = None
+    a0.chaos = None
+    a0.deadline_s = None
+    engine = build_engine(a0)
+
+    def cfg_for(speculate):
+        return ServingConfig(slots=geom["slots"], chunk_size=geom["chunk"],
+                             max_queue=256, max_seq_len=geom["cap"],
+                             kv_pool="paged", kv_page_size=geom["page"],
+                             speculate=speculate, spec_k=geom["k"])
+
+    def lane(speculate, record):
+        a = copy.copy(a0)
+        front = ContinuousBatchingScheduler(engine, cfg_for(speculate))
+        snap = run_load(front, a)
+        snap["sustained_tok_s"] = (snap["tokens_total"] / snap["wall_s"]
+                                   if snap["wall_s"] > 0 else 0.0)
+        if record is not None:
+            record.append(snap)
+        return snap
+
+    print("[bench-spec] warming both lanes' compiles...", file=sys.stderr)
+    lane(False, None)
+    lane(True, None)
+    rec = {"off": [], "on": []}
+    for rep in range(reps):
+        order = (("off", "on") if rep % 2 == 0 else ("on", "off"))
+        for kind in order:
+            print(f"[bench-spec] lane {kind} rep {rep}...", file=sys.stderr)
+            lane(kind == "on", rec[kind])
+
+    # chaos lane: 2 replicas sharing params (bit-identical), speculation on
+    # both; kill one mid-flight — the router's checkpointless retry restarts
+    # the request on the survivor and run_load parity-checks every retried
+    # request against generate (plus full greedy parity on all of them)
+    print("[bench-spec] chaos lane (kill under speculation)...",
+          file=sys.stderr)
+    a = copy.copy(a0)
+    a.requests = chaos_requests
+    a.min_new, a.max_new = 10, 16       # enough in-flight decode to land on
+    engine2 = build_engine(a0, params=engine.params)
+    rcfg = RouterConfig(serving=cfg_for(True), suspect_after_s=0.04,
+                        dead_after_s=0.12, recover_after_s=30.0,
+                        breaker_threshold=2, max_attempts=4,
+                        retry_base_delay=0.001)
+    chaos = ChaosSchedule(parse_chaos("kill:replica=0,when=busy"))
+    chaos_snap = run_load(Router([engine, engine2], rcfg), a, chaos=chaos)
+
+    def med(snaps, key):
+        return _med_notnull(s.get(key) for s in snaps)
+
+    acceptance = med(rec["on"], "spec_acceptance_rate")
+    ppt = med(rec["on"], "spec_passes_per_token")
+    tok_off = med(rec["off"], "sustained_tok_s")
+    tok_on = med(rec["on"], "sustained_tok_s")
+    parity_all = all(
+        s.get("parity_ok", False) and s.get("full_parity_bad", 1) == 0
+        for s in rec["off"] + rec["on"] + [chaos_snap])
+    lost_all = all(
+        s.get("lost", 1) == 0 and s.get("all_finished", False)
+        for s in rec["off"] + rec["on"] + [chaos_snap])
+    gates = {
+        "acceptance_rate": acceptance,
+        "acceptance_gate": 0.6,
+        "acceptance_ok": bool(acceptance is not None and acceptance >= 0.6),
+        "passes_per_token": ppt,
+        "passes_per_token_gate": 0.55,
+        "passes_ok": bool(ppt is not None and ppt <= 0.55),
+        "sustained_tok_s_off": tok_off,
+        "sustained_tok_s_on": tok_on,
+        "parity_ok_every_request": parity_all,
+        "lost_zero_all_lanes": lost_all,
+        "chaos_exhausted": bool(chaos_snap.get("chaos_exhausted", False)),
+        "chaos_retried": chaos_snap.get("retried", 0),
+        "chaos_ok": bool(chaos_snap.get("chaos_exhausted", False)
+                         and chaos_snap.get("retried", 0) >= 1),
+    }
+    ok = all(bool(gates[k]) for k in
+             ("acceptance_ok", "passes_ok", "parity_ok_every_request",
+              "lost_zero_all_lanes", "chaos_ok"))
+    out = {"metric": "spec_target_passes_per_token", "value": ppt,
+           "unit": "passes/tok", "smoke": bool(args.smoke),
+           "spec_k": geom["k"], "proposer": "ngram",
+           "geometry": geom, "requests_per_lane": requests, "reps": reps,
+           "spec_gates": gates, "gates_ok": ok,
+           "harness_note": (
+               "CPU-host A/B: passes-per-token and acceptance are the gated "
+               "(machine-independent) quantities; the tiny-model verify "
+               "forward is compute-bound on CPU, so the tok/s pair is "
+               "reported ungated — on-chip, decode is weight-bandwidth-bound "
+               "and tok/s ~ 1/passes_per_token (ROADMAP carried item)"),
+           "detail": {"off": rec["off"], "on": rec["on"],
+                      "chaos": chaos_snap}}
     if args.out:
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)

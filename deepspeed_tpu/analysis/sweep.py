@@ -8,6 +8,11 @@ Runs every contract pass against the repo's *real* programs — not toys:
   (the documented one-compile-per-key property), the dequant-hoist
   loop-invariance pin on BOTH decode bodies (while-loop generate and
   scan-lowered chunk), and the trace-time host-sync guard;
+- **spec lane** — the speculative-decoding verify step under a speculating
+  scheduler: one-compile-per-(slots, pages, page, cap, k, sampling) key
+  across a grown-k workload (draft length is runtime data), donation audit
+  on the verify fn's donated pool caches, dequant-hoist pin on the verify
+  body's paged-writeback loop;
 - **train lane** — a quantized-DP ``DeepSpeedEngine`` on the virtual CPU
   mesh: donation audit on the real ``train_step`` (state + EF residual),
   retrace lint across repeated steps;
@@ -239,6 +244,94 @@ def paged_lane(report: Report) -> None:
     set_global_mesh(None)
 
 
+# ----------------------------------------------------------------- spec lane
+def spec_lane(report: Report) -> None:
+    """Speculative-decoding contracts: the one-compile-per-(slots, pages,
+    page, cap, k, sampling)-key property across a GROWN-k workload (per-slot
+    draft length is runtime data — a dry proposer, a cap-edge slot and a
+    full-k window all ride the same compiled verify), donation audit on the
+    verify fn's donated pool caches, and the dequant-hoist loop-invariance
+    pin on the verify body's paged-writeback loop (int8 engine)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..inference.config import DeepSpeedInferenceConfig
+    from ..inference.decode_fns import build_paged_spec_verify
+    from ..inference.engine import InferenceEngine
+    from ..inference.serving.scheduler import (ContinuousBatchingScheduler,
+                                               ServingConfig)
+    from ..parallel.mesh import set_global_mesh
+    from ..models.causal_lm import gpt2_cfg
+    from .donation import donation_findings
+    from .jaxpr_passes import loop_body_findings
+    from .retrace import CompileCacheLint
+
+    cfg = gpt2_cfg(**_TINY, dtype=jnp.float32)
+    engine = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=_CAP))
+    sched = ContinuousBatchingScheduler(engine, ServingConfig(
+        slots=2, chunk_size=3, max_seq_len=_CAP, kv_pool="paged",
+        kv_page_size=8, speculate=True, spec_k=4))
+    lint = CompileCacheLint(engine._fns, target="spec-serving-engine")
+    rng = np.random.default_rng(0)
+
+    def workload():
+        # a repetitive-suffix prompt (n-gram drafts fill the window) and a
+        # random prompt (dry proposer, spec_len 0) through the SAME verify:
+        # draft-length growth is runtime data, never a compile key
+        rep = np.tile(rng.integers(0, _TINY["vocab_size"], size=4), 4) \
+            .astype(np.int32)
+        rnd = rng.integers(0, _TINY["vocab_size"], size=12).astype(np.int32)
+        hs = [sched.submit(rep, max_new_tokens=6),
+              sched.submit(rnd, max_new_tokens=6)]
+        sched.run()
+        if any(h.finish_reason != "length" for h in hs):
+            raise RuntimeError("spec_lane workload did not complete")
+
+    workload()                # warmup: every key compiles exactly once
+    lint.snapshot()
+    workload()                # grown/shrunk drafts: zero new compiles allowed
+    report.add(lint.findings())
+
+    ex = sched.executor
+    vkey = next(k for k in engine._fns if k[0] == "serve_spec_verify_paged")
+    k = vkey[5]
+    S, mp = ex.slots, ex.pool.max_pages
+    vargs = (engine.params, jnp.zeros((S, k + 1), jnp.int32), ex.pool.caches,
+             jnp.zeros((S, mp), jnp.int32), jnp.zeros((S,), jnp.int32),
+             jnp.ones((S,), jnp.int32), jnp.zeros((S,), bool))
+    report.add(donation_findings(engine._fns[vkey], vargs,
+                                 target="serve_spec_verify_paged"))
+
+    # loop-invariance: dequant hoisted out of the verify body's paged
+    # KV-writeback loop (int8 engine) — the spec analogue of the decode pins
+    raw = jax.tree_util.tree_map(np.asarray, engine.params)
+    engine_q = InferenceEngine((cfg, raw), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=_CAP,
+        weight_quant={"enabled": True, "bits": 8}))
+    from ..inference.serving.executor import ChunkedDecodeExecutor
+    exq = ChunkedDecodeExecutor(engine_q, slots=2, cap=_CAP, chunk_size=3,
+                                kv_pool="paged", kv_page_size=8)
+    verify = build_paged_spec_verify(engine_q.module, engine_q._dequant,
+                                     kv_cap=_CAP,
+                                     overlap=engine_q.comm_overlap)
+    int8_invar = lambda a: getattr(a, "dtype", None) == jnp.int8  # noqa: E731
+    qargs = (engine_q.params, jnp.zeros((S, k + 1), jnp.int32),
+             exq.pool.caches, jnp.zeros((S, exq.pool.max_pages), jnp.int32),
+             jnp.zeros((S,), jnp.int32), jnp.ones((S,), jnp.int32),
+             jnp.zeros((S,), bool))
+    findings, n_loops = loop_body_findings(
+        verify, qargs, invar_predicate=int8_invar, what="dequant-hoist",
+        site="spec_verify")
+    res = PassResult("loop_invariance", "spec_verify", findings, n_loops)
+    if n_loops == 0:
+        res.findings.append(Finding(
+            "loop_invariance", SEVERITY_ERROR, "spec_verify",
+            "no loop found — the dequant-hoist pin target vanished"))
+    report.add(res)
+    set_global_mesh(None)
+
+
 # --------------------------------------------------------------- train lane
 def train_lane(report: Report) -> None:
     import jax
@@ -392,7 +485,8 @@ def run_sweep(repo_root: str, *, ast_only: bool = False,
     report = Report()
     ast_lane(report, repo_root, paths=paths)
     if not ast_only:
-        for lane in (serving_lane, paged_lane, train_lane, overlap_lane):
+        for lane in (serving_lane, paged_lane, spec_lane, train_lane,
+                     overlap_lane):
             try:
                 lane(report)
             except Exception as e:  # a crashed lane is a failed sweep

@@ -4,3 +4,5 @@ from .diffusion_engine import DiffusionInferenceEngine, init_diffusion_inference
 from .serving import (ChunkedDecodeExecutor, ContinuousBatchingScheduler,
                       QueueFullError, RequestHandle, RequestState, ServingConfig,
                       ServingTelemetry, SlotKVPool)
+from .speculative import (DraftModelProposer, NgramProposer, SpeculativeConfig,
+                          make_proposer)

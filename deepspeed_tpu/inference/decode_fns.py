@@ -129,6 +129,97 @@ def build_prefix_prefill(module, dequant, overlap=None):
     return prefix_prefill
 
 
+def build_spec_verify(module, dequant, overlap=None):
+    """Speculative one-pass verify over a slot-batch (dense slot-row caches).
+
+    ``ids (S, t)`` is each slot's verify window ``[cur_tok, draft_0 ..
+    draft_{t-2}]``; the forward runs in ``prefix_fill`` mode at cache offset
+    ``lens`` — the window's K/V scatter into rows ``lens + j`` and every
+    window position attends over committed rows + the in-window prefix
+    (``key_pos <= query_pos``), exactly the PR 9 suffix-prefill math. Unlike
+    :func:`build_prefix_prefill` the LM head runs at EVERY window position
+    (``logits_positions=None``): the accept rule needs the target's
+    distribution after each draft prefix.
+
+    Rollback is the caller's job and is free: rows written past the accepted
+    prefix stay stale-but-masked (attention masks ``>= cache_len``) and are
+    overwritten by later appends — committing is a ``cache_len`` advance,
+    rejecting is not advancing. Returns ``(logits (S, t, V), new_caches)``.
+    """
+
+    def spec_verify(params, ids, caches, lens):
+        b, t = ids.shape
+        positions = lens[:, None] + jnp.arange(t)[None]
+        with overlap_scope(overlap):
+            logits, new_caches = module.apply(
+                {"params": dequant(params)}, ids, positions=positions,
+                caches=caches, cache_lens=lens,
+                logits_positions=None, prefix_fill=True)
+        return logits, new_caches
+
+    return spec_verify
+
+
+def build_paged_spec_verify(module, dequant, kv_cap: int, overlap=None):
+    """Paged sibling of :func:`build_spec_verify`: gather each slot's pages to
+    the dense view once, run the same ``prefix_fill`` verify forward, then
+    mirror ONLY the valid window rows ``[lens, lens + valid)`` of live slots
+    back through the page table (the paged chunk's end-of-chunk writeback
+    idiom). ``valid (S,)`` is ``spec_len + 1`` — the cur-token row plus the
+    real (un-padded) draft rows; pad rows, inactive slots, and rows at/past
+    ``kv_cap`` route to the out-of-range page index and the scatter drops
+    them, so released or shared pages are never written.
+
+    The mirror is a ``fori_loop`` over the window rows — the loop the
+    analysis sweep's dequant pin targets: ``dequant`` collapses the quantized
+    params ONCE above it, so int8 payloads must never appear as loop-body
+    inputs (the same loop-invariance contract as both decode-chunk bodies).
+    """
+    from ..ops.paged_attention import gather_kv_dense
+
+    def spec_verify(params, ids, caches, page_table, lens, valid, active):
+        # hoisted: dequant once per verify dispatch, never inside the mirror
+        params = dequant(params)
+        b, t = ids.shape
+        ps = caches[0]["k"].shape[2]
+        mp = page_table.shape[1]
+        P_total = caches[0]["k"].shape[0]
+        dense = [dict(zip(("k", "v"),
+                          gather_kv_dense(c["k"], c["v"], page_table, kv_cap)))
+                 for c in caches]
+        positions = lens[:, None] + jnp.arange(t)[None]
+        with overlap_scope(overlap):
+            logits, dense = module.apply(
+                {"params": params}, ids, positions=positions,
+                caches=dense, cache_lens=lens,
+                logits_positions=None, prefix_fill=True)
+
+        def mirror(j, pages):
+            rows = lens + j
+            page_pos = jnp.clip(rows // ps, 0, mp - 1)
+            pidx = jnp.where(active & (j < valid) & (rows < kv_cap),
+                             jnp.take_along_axis(
+                                 page_table, page_pos[:, None], axis=1)[:, 0],
+                             P_total)
+            off = rows % ps
+            idx = jnp.minimum(rows, kv_cap - 1)[:, None, None, None]
+            out = []
+            for c, dn in zip(pages, dense):
+                k_new = jnp.take_along_axis(dn["k"], idx, axis=2)[:, :, 0, :]
+                v_new = jnp.take_along_axis(dn["v"], idx, axis=2)[:, :, 0, :]
+                out.append(
+                    {"k": c["k"].at[pidx, :, off, :].set(
+                        k_new.astype(c["k"].dtype)),
+                     "v": c["v"].at[pidx, :, off, :].set(
+                        v_new.astype(c["v"].dtype))})
+            return out
+
+        new_caches = jax.lax.fori_loop(0, t, mirror, list(caches))
+        return logits, new_caches
+
+    return spec_verify
+
+
 def build_decode_loop(module, dequant, select, gen_cap: int, overlap=None):
     """Whole-batch run-to-completion decode: ONE ``lax.while_loop`` for all remaining
     tokens, EOS termination as an on-device reduction in the loop condition

@@ -41,8 +41,10 @@ from ...observability.trace import get_tracer
 from ...utils.fault_injection import fault_point
 from ...utils.nvtx import annotate
 from ..decode_fns import (build_decode_chunk, build_paged_decode_chunk,
-                          build_prefill, build_prefix_prefill,
+                          build_paged_spec_verify, build_prefill,
+                          build_prefix_prefill, build_spec_verify,
                           make_slot_select_fn)
+from ..speculative import accept_tokens
 from .kv_pool import PagedKVPool, SlotKVPool
 
 
@@ -79,6 +81,19 @@ class ChunkResult:
     remaining: np.ndarray    # (S,) decode budget left
     steps: np.ndarray        # (S,) per-request tokens emitted so far
     elapsed: float           # wall seconds for dispatch + fetch
+
+
+@dataclass
+class SpecResult(ChunkResult):
+    """One speculative verify round, harvest-compatible with a chunk: ``buf``
+    is (S, k+1) wide and a slot's real tokens are still the prefix of length
+    ``steps_out - steps_in``, so the scheduler's chunk harvest works
+    unchanged. ``proposed``/``accepted`` feed the ``serving/spec_*``
+    telemetry; ``draft_s`` is filled by the scheduler (the proposer runs on
+    the host before the dispatch)."""
+    proposed: int = 0        # real draft tokens offered this round
+    accepted: int = 0        # draft tokens that survived accept/reject
+    draft_s: float = 0.0     # host proposer wall seconds (set by caller)
 
 
 class ChunkedDecodeExecutor:
@@ -325,12 +340,68 @@ class ChunkedDecodeExecutor:
             fns[key] = jax.jit(prefill, donate_argnums=(1,))
         return fns[key]
 
+    def _spec_verify_fn(self, k: int):
+        """Speculative one-pass verify: ONE compile per (slots, cap, k,
+        sampling) key (paged adds the pool geometry, mirroring the chunk
+        key). ``k`` is the static window width minus the cur-token row —
+        per-slot draft LENGTHS are runtime data (``valid``), so shrunken
+        proposals at the cap edge or a dry proposer never mint a new key.
+        The pool caches/pages are donated like every other decode dispatch."""
+        if self.paged:
+            key = ("serve_spec_verify_paged", self.slots,
+                   self.pool.total_pages, self.pool.page_size, self.cap, k,
+                   self.sampling)
+        else:
+            key = ("serve_spec_verify", self.slots, self.cap, k,
+                   self.sampling)
+        fns = self.engine._fns
+        if key not in fns:
+            overlap = getattr(self.engine, "comm_overlap", None)
+            if self.paged:
+                fn = build_paged_spec_verify(self.engine.module,
+                                             self.engine._dequant,
+                                             kv_cap=self.cap, overlap=overlap)
+            else:
+                fn = build_spec_verify(self.engine.module,
+                                       self.engine._dequant, overlap=overlap)
+            fns[key] = jax.jit(fn, donate_argnums=(2,))   # caches/pages
+        return fns[key]
+
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.buckets:
             if prompt_len <= b:
                 return b
         raise ValueError(f"prompt length {prompt_len} exceeds max_prompt_len="
                          f"{self.max_prompt_len}")
+
+    def _dispatch_watched(self, timed):
+        """Run ``timed`` under the chunk watchdog (when armed): dispatch +
+        host fetch on a worker thread, :class:`ChunkTimeoutError` on overrun.
+        The first dispatch per executor pays its XLA compile inside the timed
+        region — it is granted ``cold_chunk_grace_s`` so a routine compile
+        doesn't read as a wedged replica (a genuinely hung compile still
+        trips)."""
+        if self.chunk_deadline_s is None:
+            return timed()
+        deadline = (self.chunk_deadline_s if self._warm_chunk
+                    else max(self.chunk_deadline_s, self.cold_chunk_grace_s))
+        box = {}
+
+        def runner():
+            try:
+                box["out"] = timed()
+            except BaseException as e:          # surfaced on the caller thread
+                box["exc"] = e
+
+        th = threading.Thread(target=runner, daemon=True,
+                              name="ds-serve-chunk-watchdog")
+        th.start()
+        th.join(deadline)
+        if th.is_alive():
+            raise ChunkTimeoutError(deadline)
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
 
     # -------------------------------------------------------------------- steps
     def prefill_into_slot(self, slot: int, prompt: np.ndarray, seed: int = 0,
@@ -488,31 +559,7 @@ class ChunkedDecodeExecutor:
                         np.asarray(remaining_d), np.asarray(steps_d))
             return host, caches
 
-        if self.chunk_deadline_s is None:
-            host, caches = timed()
-        else:
-            # the first chunk per executor pays its XLA compile inside the timed
-            # region — grant it the cold grace so a routine compile doesn't read
-            # as a wedged replica (a genuinely hung compile still trips)
-            deadline = (self.chunk_deadline_s if self._warm_chunk
-                        else max(self.chunk_deadline_s, self.cold_chunk_grace_s))
-            box = {}
-
-            def runner():
-                try:
-                    box["out"] = timed()
-                except BaseException as e:      # surfaced on the caller thread
-                    box["exc"] = e
-
-            th = threading.Thread(target=runner, daemon=True,
-                                  name="ds-serve-chunk-watchdog")
-            th.start()
-            th.join(deadline)
-            if th.is_alive():
-                raise ChunkTimeoutError(deadline)
-            if "exc" in box:
-                raise box["exc"]
-            host, caches = box["out"]
+        host, caches = self._dispatch_watched(timed)
         self._warm_chunk = True
         obs_profiler.tick("decode_chunk")
         self.pool.caches = caches
@@ -520,3 +567,102 @@ class ChunkedDecodeExecutor:
         return ChunkResult(buf=buf, toks=toks_d, lens=lens_d, active=active_d,
                            remaining=remaining_d, steps=steps_d,
                            elapsed=time.perf_counter() - t0)
+
+    def run_spec_round(self, toks: np.ndarray, lens: np.ndarray,
+                       active: np.ndarray, remaining: np.ndarray,
+                       eos_ids: np.ndarray, seeds: np.ndarray,
+                       steps: np.ndarray, proposals: np.ndarray,
+                       spec_lens: np.ndarray) -> SpecResult:
+        """One draft-verify round over the slot-batch: a single target forward
+        scores every slot's ``[cur_tok, draft...]`` window, the accept rule
+        runs on the host, and commitment is a per-slot ``lens`` advance.
+
+        ``proposals (S, k)`` holds each slot's draft tokens (pad beyond
+        ``spec_lens[s]`` is arbitrary — pad rows are neither attended as
+        committed state nor mirrored to pages, and their logits are never
+        read). A slot with ``spec_lens == 0`` degenerates to a plain
+        single-token decode step through the same compiled shape, which is
+        how the cap-edge truncation and a dry proposer are handled — no
+        separate fallback path exists to drift from.
+
+        Same donation/watchdog/fault-surface as :meth:`run_chunk` (the
+        ``serving.spec_verify`` fault point sits where ``chunk_compute``
+        does); a failed dispatch leaves the pool unrecoverable and callers
+        recover via ``reset_pool``."""
+        self.engine._activate()
+        S = int(toks.shape[0])
+        proposals = np.asarray(proposals, np.int32).reshape(S, -1)
+        k = int(proposals.shape[1])
+        fn = self._spec_verify_fn(k)
+        caches_in = self.pool.caches
+        ids = np.concatenate(
+            [np.asarray(toks, np.int32).reshape(-1, 1), proposals], axis=1)
+        spec_lens = np.asarray(spec_lens, np.int32)
+        valid = spec_lens + 1
+        if self.paged:
+            args = (self.engine.params, jnp.asarray(ids), caches_in,
+                    jnp.asarray(self.pool.page_table),
+                    jnp.asarray(lens, jnp.int32), jnp.asarray(valid, jnp.int32),
+                    jnp.asarray(active, bool))
+        else:
+            args = (self.engine.params, jnp.asarray(ids), caches_in,
+                    jnp.asarray(lens, jnp.int32))
+        t0 = time.perf_counter()
+
+        def timed():
+            # the mid-verify chaos/injection seam: after the proposer built
+            # the window, before/through the verify dispatch + logits fetch
+            fault_point("serving.spec_verify")
+            if self._stall_next > 0:
+                stall, self._stall_next = self._stall_next, 0.0
+                time.sleep(stall)
+            with annotate("serving.spec_verify"):
+                logits, caches = fn(*args)
+                # lint: host-sync-ok (round-boundary harvest: accept/reject
+                # needs the window logits on the host; this fetch IS the
+                # boundary, the spec analogue of the chunk harvest)
+                return np.asarray(logits), caches
+
+        logits, caches = self._dispatch_watched(timed)
+        self._warm_chunk = True
+        obs_profiler.tick("spec_verify")
+        self.pool.caches = caches
+
+        buf = np.zeros((S, k + 1), np.int32)
+        toks_out = np.asarray(toks, np.int32).copy()
+        lens_out = np.asarray(lens, np.int32).copy()
+        active_out = np.asarray(active, bool).copy()
+        remaining_out = np.asarray(remaining, np.int32).copy()
+        steps_out = np.asarray(steps, np.int32).copy()
+        proposed = accepted = 0
+        for s in range(S):
+            if not active_out[s]:
+                continue
+            L = int(spec_lens[s])
+            proposed += L
+            emitted, acc = accept_tokens(
+                proposals[s, :L], logits[s, :L + 1], sampling=self.sampling,
+                base_key=self._base_key, seed=int(seeds[s]),
+                step0=int(steps[s]))
+            accepted += acc
+            # chunk semantics on the emitted stream: clamp to the decode
+            # budget, truncate at the first EOS (inclusive), then commit
+            r = int(remaining_out[s])
+            if len(emitted) > r:
+                emitted = emitted[:r]
+            eos = int(eos_ids[s])
+            if eos >= 0 and eos in emitted:
+                emitted = emitted[:emitted.index(eos) + 1]
+            e = len(emitted)
+            buf[s, :e] = emitted
+            toks_out[s] = emitted[-1]
+            lens_out[s] += e
+            steps_out[s] += e
+            remaining_out[s] = r - e
+            if remaining_out[s] <= 0 or (eos >= 0 and emitted[-1] == eos):
+                active_out[s] = False
+        return SpecResult(buf=buf, toks=toks_out.reshape(-1, 1),
+                          lens=lens_out, active=active_out,
+                          remaining=remaining_out, steps=steps_out,
+                          elapsed=time.perf_counter() - t0,
+                          proposed=proposed, accepted=accepted)

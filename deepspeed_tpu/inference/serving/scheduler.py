@@ -45,6 +45,7 @@ import numpy as np
 from ...observability.trace import CAT_SERVING, get_tracer
 from ...utils.fault_injection import fault_point, retry_with_backoff
 from ...utils.logging import logger
+from ..speculative import SpeculativeConfig, make_proposer
 from .executor import ChunkedDecodeExecutor
 from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .telemetry import ServingTelemetry, adaptive_retry_after
@@ -96,6 +97,15 @@ class ServingConfig:
     kv_page_size: int = 16
     kv_total_pages: Optional[int] = None   # HBM budget in pages (None = match
     #   the slot-row pool's bytes: slots * ceil(cap/page) + the null page)
+    # speculative decoding: every decode chunk becomes ONE draft-propose /
+    # one-pass-verify round (greedy output stays bit-identical; sampled keeps
+    # the per-slot key-stream distribution exactly — see inference.speculative)
+    speculate: bool = False
+    spec_k: int = 4                     # draft tokens per verify window
+    spec_proposer: str = "ngram"        # "ngram" | "draft_model"
+    spec_ngram_max: int = 4
+    spec_ngram_min: int = 1
+    spec_draft_engine: object = None    # tiny engine for "draft_model"
 
 
 def validate_admission(prompt, max_new_tokens: Optional[int],
@@ -176,6 +186,14 @@ class ContinuousBatchingScheduler:
             chunk_deadline_s=cfg.chunk_deadline_s, kv_pool=cfg.kv_pool,
             kv_page_size=cfg.kv_page_size, kv_total_pages=cfg.kv_total_pages)
         self.cap = cap
+        self.proposer = None
+        self._spec_cfg: Optional[SpeculativeConfig] = None
+        if cfg.speculate:
+            self._spec_cfg = SpeculativeConfig(
+                k=cfg.spec_k, proposer=cfg.spec_proposer,
+                ngram_max=cfg.spec_ngram_max, ngram_min=cfg.spec_ngram_min,
+                draft_engine=cfg.spec_draft_engine)
+            self.proposer = make_proposer(self._spec_cfg)
         self.telemetry = ServingTelemetry(monitor)
         self._tracer = get_tracer()
         self.prefix_cache: Optional[PrefixCache] = None
@@ -437,6 +455,14 @@ class ContinuousBatchingScheduler:
             # a prefix hit can only need fewer pages. The slot pool reduces
             # to its free-slot check. FIFO: a head that doesn't fit waits.
             need_tokens = int(head.prompt.size) + int(head.max_new_tokens)
+            if self.proposer is not None:
+                # speculation headroom: a verify window writes up to spec_k
+                # draft rows past the committed length before the accept rule
+                # trims them — admit only when those rows fit too, so a
+                # mid-stream round never lands on an unreserved page. Clamped
+                # to the cap: the per-slot proposal limit already shrinks the
+                # window near the cap edge.
+                need_tokens = min(need_tokens + self._spec_cfg.k, self.cap)
             if not pool.can_admit(need_tokens):
                 # admission-pressure eviction (paged): cached prefixes pin
                 # real pool pages, so a full free list trades the coldest
@@ -585,6 +611,8 @@ class ContinuousBatchingScheduler:
 
         def attempt():
             fault_point("serving.decode_chunk")
+            if self.proposer is not None:
+                return self._spec_round()
             return self.executor.run_chunk(
                 self._toks, self._lens, self._active, self._remaining,
                 self._eos, self._seeds, self._steps)
@@ -648,7 +676,45 @@ class ContinuousBatchingScheduler:
             self._finalize(h, RequestState.FINISHED, reason, now)
             self._release(int(slot))
         self.telemetry.on_chunk(total, res.elapsed)
+        if self.proposer is not None:
+            self.telemetry.on_spec(res.proposed, res.accepted, total,
+                                   res.draft_s, res.elapsed)
         return True
+
+    def _spec_round(self):
+        """Build each active slot's draft window on the host (the proposer
+        sees the request's full prompt+generated stream — pure host state, so
+        a checkpointless retry re-derives the same drafts anywhere) and run
+        one fixed-shape verify round through the executor."""
+        k = self._spec_cfg.k
+        S = self.config.slots
+        proposals = np.zeros((S, k), np.int32)
+        spec_lens = np.zeros(S, np.int32)
+        t0 = time.perf_counter()
+        for slot, h in enumerate(self._slot_req):
+            if h is None or not self._active[slot]:
+                continue
+            # window rows [lens, lens+L] must fit the cap, and an L-draft
+            # round can emit L+1 tokens — cap-edge and budget-edge slots get
+            # a truncated (possibly empty) window, degenerating to the plain
+            # single-token step through the same compiled shape
+            limit = min(k, self.cap - 1 - int(self._lens[slot]),
+                        int(self._remaining[slot]) - 1)
+            if limit <= 0:
+                continue
+            ctx = np.concatenate([h.prompt.astype(np.int32),
+                                  np.asarray(h.tokens, np.int32)])
+            draft = np.asarray(self.proposer.propose(ctx, limit), np.int32)
+            L = min(int(draft.size), limit)
+            if L > 0:
+                proposals[slot, :L] = draft[:L]
+                spec_lens[slot] = L
+        draft_s = time.perf_counter() - t0
+        res = self.executor.run_spec_round(
+            self._toks, self._lens, self._active, self._remaining,
+            self._eos, self._seeds, self._steps, proposals, spec_lens)
+        res.draft_s = draft_s
+        return res
 
     # --------------------------------------------------------------- lifecycle
     def _finalize(self, handle: RequestHandle, state: RequestState,

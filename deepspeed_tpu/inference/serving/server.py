@@ -165,6 +165,18 @@ def make_status_provider(front, autoscaler=None, recorder=None,
                    for r in front.replicas):
                 rep = front.prefix_cache_report()
                 doc["prefix_hit_rate"] = rep.get("hit_rate")
+            specs = [r.scheduler.telemetry.spec for r in front.replicas
+                     if getattr(r.scheduler.telemetry, "spec_enabled", False)]
+            if specs:
+                proposed = sum(s.proposed for s in specs)
+                doc["speculative"] = {
+                    "proposed": proposed,
+                    "accepted": sum(s.accepted for s in specs),
+                    "acceptance_rate": (sum(s.accepted for s in specs)
+                                        / proposed if proposed else 0.0),
+                    "passes_per_token": (
+                        sum(s.rounds for s in specs)
+                        / max(1, sum(s.tokens for s in specs)))}
         else:
             tel = front.telemetry
             pool = front.executor.pool
@@ -182,6 +194,12 @@ def make_status_provider(front, autoscaler=None, recorder=None,
                 doc["pages"] = pool.stats()
             if front.prefix_cache is not None:
                 doc["prefix_hit_rate"] = front.prefix_hit_rate
+            if getattr(tel, "spec_enabled", False):
+                s = tel.spec
+                doc["speculative"] = {
+                    "proposed": s.proposed, "accepted": s.accepted,
+                    "acceptance_rate": s.acceptance_rate,
+                    "passes_per_token": s.passes_per_token}
         if autoscaler is not None:
             doc["autoscale"] = {
                 "target_replicas": autoscaler.target_replicas,
@@ -422,6 +440,16 @@ def main(argv=None) -> int:
                     help="KV page size in tokens (paged pool; default 16). "
                          "Must be a positive multiple of --chunk-size so "
                          "page boundaries stay chunk-aligned")
+    ap.add_argument("--speculate", action="store_true",
+                    help="speculative decoding: every decode chunk becomes "
+                         "one draft-propose / one-pass-verify round (n-gram "
+                         "self-speculation — greedy output is bit-identical, "
+                         "sampled stays exactly target-distributed)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per verify window (default 4)")
+    ap.add_argument("--spec-ngram-max", type=int, default=4,
+                    help="longest suffix n-gram the proposer matches "
+                         "(default 4; tried down to 1)")
     ap.add_argument("--max-queue", type=int, default=32)
     ap.add_argument("--replicas", type=int, default=1,
                     help=">=2 serves through the multi-replica router")
@@ -587,7 +615,9 @@ def main(argv=None) -> int:
                                 chunk_deadline_s=args.chunk_deadline,
                                 prefix_cache=prefix_cfg,
                                 kv_pool=args.kv_pool,
-                                kv_page_size=args.kv_page_size)
+                                kv_page_size=args.kv_page_size,
+                                speculate=args.speculate, spec_k=args.spec_k,
+                                spec_ngram_max=args.spec_ngram_max)
     monitor = _make_monitor(args)
     if recorder is not None:
         # mirror per-request attribution events into the monitor backend

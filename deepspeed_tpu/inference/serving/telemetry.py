@@ -14,7 +14,10 @@ parentheses):
 - ``serving/prefix_hit_rate``, ``serving/prefix_cached_bytes``,
   ``serving/prefix_evicted_total`` — per scheduler step, prefix cache enabled
   only (hit/miss/inserted/evicted counters + cached-token bytes ride the
-  aggregate snapshot).
+  aggregate snapshot);
+- ``serving/spec_*`` — per verify round, speculation enabled only; the
+  emission site lives in ``inference.speculative.emit_spec_events`` (the
+  subsystem that owns the semantics), this class only keeps the counters.
 
 Latency distributions are **fixed-log-bucket histograms**, not lists: memory
 stays O(1) over a week-long soak (the pre-PR-10 ``ttfts``/``tpots`` Python
@@ -27,6 +30,7 @@ from collections import deque
 from typing import Dict, Iterable, Optional
 
 from ...observability.metrics import Histogram, RegistryFeed
+from ..speculative import SpecStats, emit_spec_events
 
 
 def window_rate(times: Iterable[float], now: float,
@@ -90,6 +94,10 @@ class ServingTelemetry:
         self.prefix_hit_tokens = 0
         self._prefix_stats = None    # latest PrefixCache.stats() gauge set
         self._paged_stats = None     # latest PagedKVPool.stats() gauge set
+        # speculative-decoding counters (only advanced when speculation is on);
+        # the spec_* event emission itself lives in inference.speculative
+        self.spec = SpecStats()
+        self.spec_enabled = False
         # completion timestamps (bounded): the observed drain rate behind the
         # load-adaptive QueueFullError.retry_after hint
         self._finish_times = deque(maxlen=64)
@@ -155,6 +163,20 @@ class ServingTelemetry:
             self._write([("serving/tokens_per_sec", tokens / elapsed,
                           self._chunk_idx)])
 
+    def on_spec(self, proposed: int, accepted: int, tokens: int,
+                draft_s: float, verify_s: float) -> None:
+        """Per-verify-round speculative accounting (one round == one target
+        forward pass over the whole slot-batch)."""
+        self.spec_enabled = True
+        s = self.spec
+        s.rounds += 1
+        s.proposed += int(proposed)
+        s.accepted += int(accepted)
+        s.tokens += int(tokens)
+        s.draft_s += float(draft_s)
+        s.verify_s += float(verify_s)
+        emit_spec_events(self, s, draft_s, s.rounds)
+
     def on_rejected(self) -> None:
         self.rejected += 1
 
@@ -208,9 +230,11 @@ class ServingTelemetry:
                     self._prefix_stats["cached_bytes"]
         paged = ({f"paged_{k}": v for k, v in self._paged_stats.items()}
                  if self._paged_stats is not None else {})
+        spec = self.spec.snapshot() if self.spec_enabled else {}
         return {
             **prefix,
             **paged,
+            **spec,
             "elapsed_s": elapsed,
             "completed": self.completed,
             "rejected": self.rejected,

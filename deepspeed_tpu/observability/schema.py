@@ -45,6 +45,14 @@ TAGS: Dict[str, Tuple[str, str]] = {
                                            "(zero-copy prefix sharing)"),
     "serving/cow_copies_total": (COUNTER, "copy-on-write boundary-page "
                                           "copies at prefix bind"),
+    # ---------------------------------------------- speculative decoding (PR 18)
+    "serving/spec_acceptance_rate": (GAUGE, "cumulative draft-token "
+                                            "acceptance rate per verify round"),
+    "serving/spec_proposed_total": (COUNTER, "draft tokens offered to the "
+                                             "verifier"),
+    "serving/spec_accepted_total": (COUNTER, "draft tokens accepted by the "
+                                             "verify pass"),
+    "serving/spec_draft_ms": (GAUGE, "proposer wall time of the last round"),
     # ------------------------------------------------------------------ router
     "router/queue_depth": (GAUGE, "router admission queue depth per tick"),
     "router/retried_total": (COUNTER, "checkpointless retries (re-enqueues)"),
@@ -186,6 +194,7 @@ def is_declared(tag: str) -> bool:
 #: modules whose emission sites the tag lint walks (repo-relative paths)
 EMITTER_MODULES = (
     "deepspeed_tpu/inference/serving/telemetry.py",
+    "deepspeed_tpu/inference/speculative.py",
     "deepspeed_tpu/inference/serving/router.py",
     "deepspeed_tpu/inference/serving/autoscale.py",
     "deepspeed_tpu/inference/serving/host.py",

@@ -81,6 +81,12 @@ def render(doc: Dict) -> str:
                      f"/{_fmt(p.get('total_pages'), 0)}  "
                      f"fragmentation={_fmt(p.get('page_fragmentation'))}  "
                      f"shared={_fmt(p.get('prefix_shared_pages'), 0)}")
+    sp = doc.get("speculative")
+    if sp:
+        lines.append(f"spec: accept={_fmt(sp.get('acceptance_rate'))}  "
+                     f"accepted={_fmt(sp.get('accepted'), 0)}"
+                     f"/{_fmt(sp.get('proposed'), 0)}  "
+                     f"passes/tok={_fmt(sp.get('passes_per_token'))}")
     a = doc.get("autoscale")
     if a:
         lines.append(f"autoscale: target={a.get('target_replicas')} "

@@ -24,7 +24,61 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 
+# --------------------------------------------------------------- tier-1 budget
+#: Wall-clock budget (seconds, CPU-host on the 8-device virtual mesh) per
+#: ordered tier-1 lane inside the 870 s window (``timeout -k 10 870`` in
+#: ROADMAP.md's tier-1 command). The collection ORDER is part of the
+#: contract: lanes run strictly in rank order so an overrunning late lane
+#: loses its OWN tail to the timeout, never an established earlier lane's.
+#: Budgets are documented ceilings, not per-test enforcement — what is
+#: enforced is (a) the table summing inside the window (checked at configure
+#: time, so a new lane must take its budget from somewhere visible) and
+#: (b) the collection order actually being rank-monotone
+#: (``pytest_collection_finish`` below fails drift loudly).
+TIER1_BUDGETS_S = {
+    0: ("fault_tolerance", 120),   # subprocess SIGKILL rings + ckpt rewind
+    1: ("observability", 40),      # pure-host tracing/metrics lane
+    2: ("analysis", 70),           # contract passes over the real programs
+    3: ("serving_family", 430),    # serving + router + prefix_cache + paged_kv
+    #     + autoscale + host + net + speculative: the compiled-dispatch block
+    4: ("comm_overlap", 90),       # chunked-collective parity + bench smoke
+    5: ("weight_quant", 70),       # int4/int8 pack + fused-dequant parity
+    6: ("unranked", 50),           # models, runtime units, everything else
+}
+TIER1_WINDOW_S = 870
+
+
+def _tier1_rank(it) -> int:
+    """Collection rank of one test item (lower runs earlier); the key both
+    ``pytest_collection_modifyitems`` sorts by and the drift check audits."""
+    if "test_fault_tolerance" in it.nodeid:
+        return 0
+    if it.get_closest_marker("observability") is not None:
+        return 1                # fast lane: whole suite runs in seconds
+    if it.get_closest_marker("analysis") is not None:
+        return 2                # contract passes over the real programs
+    if "inference/serving" in it.nodeid \
+            or it.get_closest_marker("serving_router") is not None \
+            or it.get_closest_marker("prefix_cache") is not None \
+            or it.get_closest_marker("paged_kv") is not None \
+            or it.get_closest_marker("serving_autoscale") is not None \
+            or it.get_closest_marker("serving_host") is not None \
+            or it.get_closest_marker("speculative") is not None:
+        return 3
+    if it.get_closest_marker("comm_overlap") is not None:
+        return 4
+    if it.get_closest_marker("weight_quant") is not None:
+        return 5
+    return 6
+
+
 def pytest_configure(config):
+    total = sum(s for _, s in TIER1_BUDGETS_S.values())
+    if total > TIER1_WINDOW_S:
+        raise pytest.UsageError(
+            f"tier-1 lane budgets sum to {total}s > the {TIER1_WINDOW_S}s "
+            "window — a new lane must take its budget from an existing one "
+            "(edit TIER1_BUDGETS_S in tests/conftest.py)")
     config.addinivalue_line(
         "markers", "slow: long-running convergence/perf lanes "
         "(deselect with -m 'not slow')")
@@ -79,6 +133,11 @@ def pytest_configure(config):
         "resume, sever-evict-redial parity, net:* chaos grammar, partition/"
         "delay soak over real TCP children) — tier-1 fast lane; its bench "
         "smoke is marked slow")
+    config.addinivalue_line(
+        "markers", "speculative: speculative decoding lane (n-gram/draft "
+        "proposers, one-pass verify, greedy bit-identity across hit/miss/"
+        "retry/drain/migration, rejection-sampling exactness, rollback edge "
+        "cases, bench --bench-spec smoke) — tier-1 fast lane")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -86,32 +145,31 @@ def pytest_collection_modifyitems(config, items):
     land inside tier-1's wall-clock budget — the full suite can overrun it on
     CPU, and all of them sort late alphabetically ('tests/unit/runtime',
     'tests/unit/inference/serving', 'tests/unit/parallel',
-    'tests/unit/ops/test_weight_quant'). Run fault tolerance first, serving
-    second, comm-overlap third, weight-quant fourth; relative order of
-    everything else is unchanged."""
+    'tests/unit/ops/test_weight_quant'). Run lanes in ``_tier1_rank`` order
+    (budgets: ``TIER1_BUDGETS_S``); relative order within a rank is
+    unchanged."""
+    if any(_tier1_rank(it) < 6 for it in items):
+        items.sort(key=_tier1_rank)  # stable: preserves order within a rank
 
-    def rank(it):
-        if "test_fault_tolerance" in it.nodeid:
-            return 0
-        if it.get_closest_marker("observability") is not None:
-            return 1                # fast lane: whole suite runs in seconds
-        if it.get_closest_marker("analysis") is not None:
-            return 2                # contract passes over the real programs
-        if "inference/serving" in it.nodeid \
-                or it.get_closest_marker("serving_router") is not None \
-                or it.get_closest_marker("prefix_cache") is not None \
-                or it.get_closest_marker("paged_kv") is not None \
-                or it.get_closest_marker("serving_autoscale") is not None \
-                or it.get_closest_marker("serving_host") is not None:
-            return 3
-        if it.get_closest_marker("comm_overlap") is not None:
-            return 4
-        if it.get_closest_marker("weight_quant") is not None:
-            return 5
-        return 6
 
-    if any(rank(it) < 6 for it in items):
-        items.sort(key=rank)        # stable: preserves order within each rank
+def pytest_collection_finish(session):
+    """Fail collection-order drift LOUDLY: after every plugin has had its say,
+    the final item order must still be rank-monotone — otherwise a reordering
+    plugin (or a sort that silently stopped firing) would push an established
+    lane past the tier-1 timeout and the first symptom would be a flaky
+    timeout kill, not an explanation. (Run tier-1 with ``-p no:randomly``;
+    this check is what turns a violation into a one-line diagnosis.)"""
+    ranks = [_tier1_rank(it) for it in session.items]
+    for i in range(1, len(ranks)):
+        if ranks[i] < ranks[i - 1]:
+            lane = TIER1_BUDGETS_S[ranks[i]][0]
+            prev = TIER1_BUDGETS_S[ranks[i - 1]][0]
+            raise pytest.UsageError(
+                f"tier-1 collection-order drift: {session.items[i].nodeid} "
+                f"(lane {lane!r}, rank {ranks[i]}) collected after "
+                f"{session.items[i - 1].nodeid} (lane {prev!r}, rank "
+                f"{ranks[i - 1]}) — lanes must run in TIER1_BUDGETS_S order "
+                "or the window budget in tests/conftest.py is meaningless")
 
 
 @pytest.fixture(autouse=True)
