@@ -957,6 +957,16 @@ def bench_trajectory(root: str = ".", out_json: str = "BENCH_TRAJECTORY.json",
             f"| {r['metric'] or ''} | {val} | {r['unit'] or ''} "
             f"| {'y' if r['smoke'] else ''} | {gates} | {ok} |")
     md = "\n".join(md_lines) + "\n"
+    # the "## Tier-1 window" section is hand-maintained (one line per PR's
+    # measured dots/870s — ROADMAP's carried maintenance item); carry it
+    # across regenerations instead of clobbering it with the table
+    md_path = os.path.join(root, out_md)
+    if os.path.exists(md_path):
+        with open(md_path) as f:
+            prev = f.read()
+        marker = prev.find("## Tier-1 window")
+        if marker >= 0:
+            md += "\n" + prev[marker:].rstrip() + "\n"
     out = {"metric": "bench_trajectory", "artifacts": len(rows),
            # an unreadable artifact is a broken perf record, not a pass;
            # gate-less old artifacts (gates_ok None) still count as ok

@@ -172,6 +172,12 @@ def make_prompts(args, rng):
     picks = rng.integers(0, args.prefix_pool, size=n)
     prompts = [np.concatenate([pool[int(p)], t])
                for p, t in zip(picks, tails)]
+    if getattr(args, "session_style", None) == "tenant":
+        # many-tenant shared-prefix trace (the fleet-KV-economy A/B shape):
+        # every request is its own session, so session affinity carries NO
+        # locality signal — only prefix-aware dispatch can steer a shared
+        # prefix back to the replica whose cache already holds it
+        return prompts, [f"tenant{i}" for i in range(n)]
     # session = pool id: the router's affinity then concentrates each shared
     # prefix on one replica — the locality hook the per-replica caches need
     return prompts, [f"pool{int(p)}" for p in picks]
@@ -538,6 +544,10 @@ def host_config(args):
                                        if args.prefix_cache else None),
                       prefix_min_hit=(args.prefix_min_hit
                                       if args.prefix_cache else None),
+                      prefix_tier_mb=(args.prefix_tier_mb
+                                      if args.prefix_cache
+                                      and getattr(args, "prefix_tier_mb", 0.0)
+                                      else None),
                       kv_pool=args.kv_pool, kv_page_size=args.kv_page_size,
                       chunk_deadline_s=args.chunk_deadline)
 
@@ -642,7 +652,9 @@ def _build_router(args, serving_cfg, monitor=None, n_static=None, slo=None,
                              for _ in range(n0 - 1)]
     rcfg = RouterConfig(
         serving=serving_cfg, max_queue=args.max_queue,
-        slo_admission=bool(args.slo_admission if slo is None else slo))
+        slo_admission=bool(args.slo_admission if slo is None else slo),
+        prefix_aware_routing=bool(getattr(args, "prefix_aware_routing",
+                                          False)))
     if args.smoke:
         if hosted:
             # heartbeats ride a 50ms child stream: a 0.15s flatline bound
@@ -1027,6 +1039,15 @@ def main(argv=None) -> int:
                     help="prefix-cache HBM byte budget (MiB)")
     ap.add_argument("--prefix-min-hit", type=int, default=8,
                     help="minimum matched tokens for a cache hit")
+    ap.add_argument("--prefix-tier-mb", type=float, default=0.0,
+                    help="host-RAM spill rung under the prefix cache's HBM "
+                         "budget (MiB; 0 = tier off): LRU-evicted slabs "
+                         "spill to host and promote back on a later hit")
+    ap.add_argument("--prefix-aware-routing", action="store_true",
+                    help="router dispatch scores replicas by expected "
+                         "prefill-tokens-saved (cache probe / gossiped "
+                         "digests) against outstanding load; session "
+                         "affinity demotes to a tiebreaker")
     ap.add_argument("--prefix-insert-on", default="prefill",
                     choices=("prefill", "completion"),
                     help="when a prompt's KV slab enters the trie")
@@ -1071,6 +1092,13 @@ def main(argv=None) -> int:
                          "(every request parity-checked) + a chaos kill lane "
                          "with speculation on; emits BENCH_SPEC JSON gating "
                          "passes-per-token and n-gram acceptance")
+    ap.add_argument("--bench-kv-economy", action="store_true",
+                    help="fleet KV-economy acceptance A/B: a many-tenant "
+                         "shared-prefix trace over a 4-replica fleet, "
+                         "affinity-only vs prefix-aware routing (both "
+                         "tiered), a host-rung promote TTFT lane, and a "
+                         "mid-promote chaos kill lane; emits BENCH_KVECON "
+                         "JSON with gates")
     ap.add_argument("--vocab-size", type=int, default=512)
     ap.add_argument("--max-seq-len", type=int, default=128)
     ap.add_argument("--n-embd", type=int, default=128)
@@ -1279,13 +1307,13 @@ def main(argv=None) -> int:
             "enabled": True, "output_path": args.jsonl_metrics,
             "job_name": "loadgen"}))
     if (args.bench_paged or args.bench_autoscale or args.bench_hosts
-            or args.bench_net or args.bench_spec) \
+            or args.bench_net or args.bench_spec or args.bench_kv_economy) \
             and (args.flight_out or args.trace_out):
         # these lanes dispatch before the tracer/flight wiring: refusing
         # beats silently writing no bundle the caller asked for
         ap.error("--bench-paged/--bench-autoscale/--bench-hosts/--bench-net/"
-                 "--bench-spec manage their own runs; --trace-out/"
-                 "--flight-out are single-run options")
+                 "--bench-spec/--bench-kv-economy manage their own runs; "
+                 "--trace-out/--flight-out are single-run options")
     if args.bench_net:
         # the bench pins its own geometry + fleets (stdio AND socket)
         if args.bench_paged or args.bench_autoscale or args.obs_ab \
@@ -1309,6 +1337,19 @@ def main(argv=None) -> int:
             ap.error("--bench-spec manages its own lanes (incl. the chaos "
                      "one); drop --replicas/--chaos/--autoscale")
         return _run_spec_bench(args, monitor)
+    if args.bench_kv_economy:
+        # dispatched before serving_cfg: the bench pins its own geometry,
+        # many-tenant trace, per-lane cache budgets and router configs
+        if args.bench_paged or args.bench_autoscale or args.obs_ab \
+                or args.bench_net or args.bench_hosts or args.bench_spec:
+            ap.error("--bench-kv-economy is its own acceptance run; drop "
+                     "the other bench flags")
+        if args.replicas > 1 or args.chaos or args.autoscale \
+                or args.host_replicas or args.replica_endpoint:
+            ap.error("--bench-kv-economy manages its own fleets (incl. the "
+                     "chaos one); drop --replicas/--chaos/--autoscale/"
+                     "--host-replicas/--replica-endpoint")
+        return _run_kvecon_bench(args, monitor)
     if args.bench_paged:
         # dispatched before serving_cfg: the bench pins its own per-lane
         # geometries (and --kv-page-size may be None = per-lane default here)
@@ -1321,6 +1362,7 @@ def main(argv=None) -> int:
         from deepspeed_tpu.inference.serving import PrefixCacheConfig
         prefix_cfg = PrefixCacheConfig(
             max_bytes=int(args.prefix_cache_mb * 1024 * 1024),
+            host_tier_bytes=int(args.prefix_tier_mb * 1024 * 1024),
             min_hit_tokens=args.prefix_min_hit,
             min_insert_tokens=args.prefix_min_hit,
             insert_on=args.prefix_insert_on)
@@ -2271,6 +2313,233 @@ def _run_spec_bench(args, monitor) -> int:
                "reported ungated — on-chip, decode is weight-bandwidth-bound "
                "and tok/s ~ 1/passes_per_token (ROADMAP carried item)"),
            "detail": {"off": rec["off"], "on": rec["on"],
+                      "chaos": chaos_snap}}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def _run_kvecon_bench(args, monitor) -> int:
+    """Fleet KV-economy acceptance A/B (``BENCH_KVECON`` JSON).
+
+    A many-tenant shared-prefix trace (``session_style="tenant"``: every
+    request is its own session, so affinity carries NO locality signal —
+    the regime prefix-aware dispatch exists for), all lanes greedy with
+    EVERY request parity-checked against per-request ``generate``:
+
+    - **single** — one tiered scheduler: the per-process hit-rate ceiling
+      the fleet is judged against;
+    - **affinity vs aware** — the SAME trace over a 4-replica router,
+      once with legacy affinity-only dispatch and once with prefix-aware
+      scoring (both fleets tiered; fresh per-replica caches per lane).
+      Gate: aware fleet admission-level hit rate >= 0.9x the
+      single-replica ceiling AND strictly above the affinity-only lane —
+      a fleet must not pay ~Nx the cold misses just for being a fleet;
+    - **promote** — one scheduler whose device rung holds ~1 entry over a
+      1 MiB host rung, cycling 3 prefixes: nearly every hit is a
+      host-rung promote (slab restore), spilling what it evicts. Gates:
+      promote-path TTFT p50 strictly below miss TTFT p50 (a promote must
+      beat recomputing the prefill it skips), spills and promotions both
+      actually moved;
+    - **chaos** — a 2-replica prefix-aware fleet with the same churning
+      tier and ``kill:replica=0,when=restore``: the kill lands exactly
+      between the host->device promote restore and the suffix prefill.
+      The checkpointless-retry contract must hold mid-promote (lost == 0,
+      every retried request bit-exact).
+
+    Hit rates are counting gates (machine-independent); the promote lane's
+    TTFT comparison is within-lane self-controlled, so machine drift
+    cancels without interleaving."""
+    import copy
+    from deepspeed_tpu.inference.serving import (ChaosSchedule,
+                                                 ContinuousBatchingScheduler,
+                                                 PrefixCacheConfig, Router,
+                                                 RouterConfig, ServingConfig,
+                                                 parse_chaos)
+    # per-token KV bytes = n_layer * 2 * n_embd * 4B = 512; a prefix(24) +
+    # tail(<=6) prompt rounds to 4 pages = 16 KiB/entry under page=8 — the
+    # 24 KiB device budget below therefore holds exactly one entry
+    geom = dict(vocab_size=96, max_seq_len=64, n_embd=32, n_layer=2, n_head=4,
+                cap=64, slots=2, chunk=4, page=8, fleet=4, pool=4,
+                prefix_len=24, tier_mb=1.0, device_mb=4.0,
+                promote_prefix_len=40, promote_device_kb=28)
+    if args.smoke:
+        requests, reps, promote_requests, chaos_requests = 24, 1, 10, 8
+        min_moves = 2
+    else:
+        requests, reps, promote_requests, chaos_requests = 48, 2, 30, 12
+        min_moves = 5
+    a0 = copy.copy(args)
+    for key in ("vocab_size", "max_seq_len", "n_embd", "n_layer", "n_head"):
+        setattr(a0, key, geom[key])
+    a0.requests, a0.verify_parity = requests, True
+    # paced (NOT saturated) arrivals: routing can only exploit a cache entry
+    # inserted by an EARLIER request's prefill — an all-at-once burst would
+    # make every pick before any insert exists and flatten the A/B
+    a0.rate = 40.0
+    a0.max_queue = 256
+    a0.prefix_pool, a0.prefix_len = geom["pool"], geom["prefix_len"]
+    a0.prefix_cache, a0.prefix_min_hit = True, 8
+    a0.prefix_insert_on = "prefill"
+    a0.session_style = "tenant"
+    a0.prompt_style = None
+    a0.min_prompt, a0.max_prompt = 2, 6
+    a0.min_new, a0.max_new = 4, 8
+    a0.prompt_dist = a0.output_dist = None
+    a0.chaos, a0.deadline_s = None, None
+    a0.autoscale = a0.slo_admission = False
+
+    def pcfg(device_bytes):
+        return PrefixCacheConfig(
+            max_bytes=int(device_bytes),
+            host_tier_bytes=int(geom["tier_mb"] * 2**20),
+            min_hit_tokens=a0.prefix_min_hit,
+            min_insert_tokens=a0.prefix_min_hit, insert_on="prefill")
+
+    def scfg(device_bytes):
+        return ServingConfig(slots=geom["slots"], chunk_size=geom["chunk"],
+                             max_queue=256, max_seq_len=geom["cap"],
+                             kv_pool="paged", kv_page_size=geom["page"],
+                             prefix_cache=pcfg(device_bytes))
+
+    roomy = int(geom["device_mb"] * 2**20)       # holds every pool prefix
+    tight = geom["promote_device_kb"] * 1024     # holds ~one entry
+    engine = build_engine(a0)
+    engines = [engine] + [build_engine(a0, params=engine.params)
+                          for _ in range(geom["fleet"] - 1)]
+
+    def single_lane(device_bytes, n_requests, rate, record=None,
+                    prefix_len=None):
+        a = copy.copy(a0)
+        a.requests, a.rate = n_requests, rate
+        if prefix_len is not None:
+            # promote lane: a LONGER shared prefix so the prefill a promote
+            # skips dwarfs the restore's own cost — with the base 24-token
+            # prefix the saved ~6 chunk-steps roughly equal one host->device
+            # restore on the tiny CPU model and the TTFT gate reads noise
+            a.prefix_len = prefix_len
+        front = ContinuousBatchingScheduler(engine, scfg(device_bytes),
+                                            monitor=monitor)
+        snap = run_load(front, a)
+        if record is not None:
+            record.append(snap)
+        return snap
+
+    def fleet_lane(aware, record=None):
+        a = copy.copy(a0)
+        rcfg = RouterConfig(serving=scfg(roomy), max_queue=256,
+                            prefix_aware_routing=aware)
+        snap = run_load(Router(list(engines), rcfg, monitor=monitor), a)
+        snap["fleet_hit_rate"] = (snap.get("kv_economy")
+                                  or {}).get("fleet_hit_rate")
+        if record is not None:
+            record.append(snap)
+        return snap
+
+    # warm with the tight budget so the spill (gather) and promote (restore)
+    # movers compile here, not inside a measured lane — both prefix lengths,
+    # because the movers' jit keys are row counts derived from matched/prompt
+    # pages and the promote lane's longer prefix uses different ones
+    print("[bench-kvecon] warming compiles (incl. spill/promote movers)...",
+          file=sys.stderr)
+    single_lane(tight, 8, 1000.0)
+    single_lane(tight, 8, 1000.0, prefix_len=geom["promote_prefix_len"])
+    rec = {"single": [], "affinity": [], "aware": [], "promote": []}
+    for rep in range(reps):
+        print(f"[bench-kvecon] rep {rep}: single / affinity / aware / "
+              "promote lanes...", file=sys.stderr)
+        single_lane(roomy, requests, a0.rate, rec["single"])
+        order = (("affinity", "aware") if rep % 2 == 0
+                 else ("aware", "affinity"))
+        for kind in order:
+            fleet_lane(kind == "aware", rec[kind])
+        # promote lane: unsaturated so TTFT reflects the promote itself
+        single_lane(tight, promote_requests, 12.0, rec["promote"],
+                    prefix_len=geom["promote_prefix_len"])
+
+    # chaos lane: 2 prefix-aware replicas sharing params, the same churning
+    # tight tier; when=restore kills replica 0 between its promote restore
+    # and the suffix prefill — the retry must land on the survivor bit-exact
+    print("[bench-kvecon] chaos lane (kill mid-promote)...", file=sys.stderr)
+    a = copy.copy(a0)
+    a.requests, a.rate = chaos_requests, 1000.0
+    a.prefix_pool = 2
+    a.min_new, a.max_new = 10, 16
+    rcfg = RouterConfig(serving=scfg(tight), max_queue=256,
+                        prefix_aware_routing=True, suspect_after_s=0.04,
+                        dead_after_s=0.12, recover_after_s=30.0,
+                        breaker_threshold=2, max_attempts=4,
+                        retry_base_delay=0.001)
+    chaos = ChaosSchedule(parse_chaos("kill:replica=0,when=restore"))
+    chaos_snap = run_load(Router(engines[:2], rcfg), a, chaos=chaos)
+
+    def med(snaps, key):
+        return _med_notnull(s.get(key) for s in snaps)
+
+    hr_single = med(rec["single"], "prefix_hit_rate")
+    hr_affinity = med(rec["affinity"], "fleet_hit_rate")
+    hr_aware = med(rec["aware"], "fleet_hit_rate")
+    hit_p50 = _med_notnull((s.get("prefix_trace") or {}).get("ttft_hit_ms_p50")
+                           for s in rec["promote"])
+    miss_p50 = _med_notnull(
+        (s.get("prefix_trace") or {}).get("ttft_miss_ms_p50")
+        for s in rec["promote"])
+    spills = sum((s.get("prefix_cache_report") or {}).get("spills", 0)
+                 for s in rec["promote"])
+    promotions = sum((s.get("prefix_cache_report") or {}).get("promotions", 0)
+                     for s in rec["promote"])
+    all_lanes = (rec["single"] + rec["affinity"] + rec["aware"]
+                 + rec["promote"] + [chaos_snap])
+    parity_all = all(
+        s.get("parity_ok", False) and s.get("full_parity_bad", 1) == 0
+        for s in all_lanes)
+    lost_all = all(
+        s.get("lost", 1) == 0 and s.get("all_finished", False)
+        for s in all_lanes)
+    gates = {
+        "single_hit_rate": hr_single,
+        "fleet_hit_rate_affinity": hr_affinity,
+        "fleet_hit_rate_aware": hr_aware,
+        "fleet_hit_floor": 0.9,
+        "fleet_hit_ok": bool(hr_aware is not None and hr_single is not None
+                             and hr_aware >= 0.9 * hr_single),
+        "aware_beats_affinity": bool(hr_aware is not None
+                                     and hr_affinity is not None
+                                     and hr_aware > hr_affinity),
+        "promote_ttft_hit_ms_p50": hit_p50,
+        "promote_ttft_miss_ms_p50": miss_p50,
+        "promote_ok": bool(hit_p50 is not None and miss_p50 is not None
+                           and hit_p50 < miss_p50),
+        "tier_spills": spills,
+        "tier_promotions": promotions,
+        "tier_exercised": bool(spills >= min_moves
+                               and promotions >= min_moves),
+        "parity_ok_every_request": parity_all,
+        "lost_zero_all_lanes": lost_all,
+        "chaos_exhausted": bool(chaos_snap.get("chaos_exhausted", False)),
+        "chaos_retried": chaos_snap.get("retried", 0),
+        "chaos_ok": bool(chaos_snap.get("chaos_exhausted", False)
+                         and chaos_snap.get("retried", 0) >= 1),
+    }
+    ok = all(bool(gates[k]) for k in
+             ("fleet_hit_ok", "aware_beats_affinity", "promote_ok",
+              "tier_exercised", "parity_ok_every_request",
+              "lost_zero_all_lanes", "chaos_ok"))
+    out = {"metric": "fleet_prefix_hit_rate", "value": hr_aware,
+           "unit": "hit_rate", "smoke": bool(args.smoke),
+           "geometry": geom, "requests_per_lane": requests, "reps": reps,
+           "kvecon_gates": gates, "gates_ok": ok,
+           "harness_note": (
+               "many-tenant trace: sessions are per-request, so the "
+               "affinity-only lane has no locality signal — its fleet hit "
+               "rate is the cost of cache-blind dispatch, reported as the "
+               "A/B foil; the gated quantities (hit rates, spill/promote "
+               "counts, parity, lost) are machine-independent, and the "
+               "promote TTFT gate is within-lane self-controlled"),
+           "detail": {"single": rec["single"], "affinity": rec["affinity"],
+                      "aware": rec["aware"], "promote": rec["promote"],
                       "chaos": chaos_snap}}
     if args.out:
         with open(args.out, "w") as f:

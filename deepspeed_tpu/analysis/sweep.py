@@ -13,6 +13,11 @@ Runs every contract pass against the repo's *real* programs — not toys:
   across a grown-k workload (draft length is runtime data), donation audit
   on the verify fn's donated pool caches, dequant-hoist pin on the verify
   body's paged-writeback loop;
+- **kvecon lane** — the tiered prefix cache's spill/promote movers under a
+  real scheduler forced through device-evict→spill→promote traffic: a second
+  identical workload must mint zero new mover compile keys (promote width is
+  page-bounded, never per-request), the promote restore must actually donate
+  the pool, and the spill gather must not donate it;
 - **train lane** — a quantized-DP ``DeepSpeedEngine`` on the virtual CPU
   mesh: donation audit on the real ``train_step`` (state + EF residual),
   retrace lint across repeated steps;
@@ -332,6 +337,102 @@ def spec_lane(report: Report) -> None:
     set_global_mesh(None)
 
 
+# --------------------------------------------------------------- kvecon lane
+def kvecon_lane(report: Report) -> None:
+    """Tiered prefix-cache contracts (PR 19): the spill/promote movers —
+    ``gather_pages`` at device-LRU eviction, ``promote_prefix``'s restore at
+    host→device promote — are module-level jit singletons keyed only by row
+    count, so a second identical spill→promote workload must mint ZERO new
+    compile entries (no per-promote keys); the restore side must actually
+    donate the pool (no silent copy-fallback), and the gather side must NOT
+    donate it (the spilled entry's source pages stay live for readers)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ..inference.config import DeepSpeedInferenceConfig
+    from ..inference.engine import InferenceEngine
+    from ..inference.serving import kv_pool as kvp
+    from ..inference.serving.prefix_cache import PrefixCacheConfig
+    from ..inference.serving.scheduler import (ContinuousBatchingScheduler,
+                                               ServingConfig)
+    from ..models.causal_lm import gpt2_cfg
+    from ..parallel.mesh import set_global_mesh
+    from .donation import _flat_args_info, donation_findings
+
+    cfg = gpt2_cfg(**_TINY, dtype=jnp.float32)
+    engine = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=_CAP))
+    # HBM budget sized for exactly ONE prompt-length entry: the second insert
+    # evicts the first, which spills to the (generous) host rung; re-serving
+    # the first prefix then promotes it back — the canonical tier traffic
+    sched = ContinuousBatchingScheduler(engine, ServingConfig(
+        slots=2, chunk_size=2, max_seq_len=_CAP, kv_pool="paged",
+        kv_page_size=4,
+        prefix_cache=PrefixCacheConfig(
+            max_bytes=12 * 1024, host_tier_bytes=1 << 20,
+            min_hit_tokens=4, min_insert_tokens=4, insert_on="prefill")))
+    rng = np.random.default_rng(7)
+    pa = rng.integers(0, _TINY["vocab_size"], size=16).astype(np.int32)
+    pb = rng.integers(0, _TINY["vocab_size"], size=16).astype(np.int32)
+
+    def serve(prompt):
+        h = sched.submit(prompt, max_new_tokens=2)
+        sched.run()
+        if h.finish_reason != "length":
+            raise RuntimeError("kvecon_lane workload did not complete")
+
+    def workload():
+        serve(pa)               # insert A (fills the device budget)
+        serve(pb)               # insert B -> A evicts -> spills (gather)
+        serve(pa)               # A: host hit -> promote (restore)
+
+    workload()
+    pc = sched.prefix_cache
+    s = pc.stats()
+    wired = PassResult("retrace", "tiered-prefix-movers", checked=2)
+    if s["spills"] < 1 or s["promotions"] < 1:
+        wired.findings.append(Finding(
+            "retrace", SEVERITY_ERROR, "tiered-prefix-movers",
+            f"spill/promote workload exercised neither mover "
+            f"(spills={s['spills']} promotions={s['promotions']}) — the "
+            "lane's pin targets vanished"))
+    g0 = kvp._paged_gather_jit.cache_info().currsize
+    r0 = kvp._paged_restore_jit.cache_info().currsize
+    workload()                  # identical traffic: zero new compile keys
+    g1 = kvp._paged_gather_jit.cache_info().currsize
+    r1 = kvp._paged_restore_jit.cache_info().currsize
+    if (g1, r1) != (g0, r0):
+        wired.findings.append(Finding(
+            "retrace", SEVERITY_ERROR, "tiered-prefix-movers",
+            f"a second identical spill/promote workload minted new mover "
+            f"compile keys (gather {g0}->{g1}, restore {r0}->{r1}) — "
+            "promote width must stay page-bounded, never per-request"))
+    report.add(wired)
+
+    # donation: the promote restore donates the pool; the spill gather must
+    # not (it reads pages the trie may still share with in-flight slots)
+    pool = sched.executor.pool
+    slot = pool.acquire(tokens=8)
+    n = pool.pages_for(8)
+    tbl = jnp.asarray(np.asarray(pool.page_table[slot, :n], np.int32))
+    R = n * pool.page_size
+    slab = pool.gather_pages(np.asarray(pool.page_table[slot, :n]), R)
+    report.add(donation_findings(kvp._paged_restore_jit(R),
+                                 (pool.caches, slab, tbl),
+                                 target="paged_restore(promote)"))
+    gres = PassResult("donation", "paged_gather(spill)", checked=1)
+    lowered = kvp._paged_gather_jit(R).lower(pool.caches, tbl)
+    donated = [p for p, info in _flat_args_info(lowered) if info.donated]
+    if donated:
+        gres.findings.append(Finding(
+            "donation", SEVERITY_ERROR, "paged_gather(spill)",
+            f"spill gather donates {donated[:4]} — the gathered pages stay "
+            "referenced by live slots and the trie; donation here would "
+            "poison the pool at eviction time"))
+    report.add(gres)
+    pool.release(slot)
+    set_global_mesh(None)
+
+
 # --------------------------------------------------------------- train lane
 def train_lane(report: Report) -> None:
     import jax
@@ -485,8 +586,8 @@ def run_sweep(repo_root: str, *, ast_only: bool = False,
     report = Report()
     ast_lane(report, repo_root, paths=paths)
     if not ast_only:
-        for lane in (serving_lane, paged_lane, spec_lane, train_lane,
-                     overlap_lane):
+        for lane in (serving_lane, paged_lane, spec_lane, kvecon_lane,
+                     train_lane, overlap_lane):
             try:
                 lane(report)
             except Exception as e:  # a crashed lane is a failed sweep

@@ -437,14 +437,28 @@ class ChunkedDecodeExecutor:
             t0 = time.perf_counter()
             tr0 = time.monotonic()
             if self.paged:
-                # zero-copy hit: the prefix pages were BOUND into the slot's
-                # table at admission (refcount bump + one COW page) — there is
-                # no slab restore to pay; the span records the bind seam
                 fn = self._suffix_prefill_fn_paged(bucket)
-                tracer.record_span("bind_prefix", trace_ctx, tr0,
-                                   time.monotonic(),
-                                   attrs={"slot": slot,
-                                          "prefix_len": int(prefix_len)})
+                if prefix_slab is not None:
+                    # host-tier PROMOTE hit: the match lives as a spilled
+                    # dense slab, not as live pages — restore it into the
+                    # slot's (all-fresh, unshared) pages, paying one
+                    # host→device copy instead of a re-prefill
+                    with annotate("serving.restore_prefix"):
+                        self.pool.promote_prefix(slot, prefix_slab, prefix_len)
+                    tracer.record_span("restore_prefix", trace_ctx, tr0,
+                                       time.monotonic(),
+                                       attrs={"slot": slot,
+                                              "prefix_len": int(prefix_len),
+                                              "promoted": True})
+                else:
+                    # zero-copy hit: the prefix pages were BOUND into the
+                    # slot's table at admission (refcount bump + one COW
+                    # page) — there is no slab restore to pay; the span
+                    # records the bind seam
+                    tracer.record_span("bind_prefix", trace_ctx, tr0,
+                                       time.monotonic(),
+                                       attrs={"slot": slot,
+                                              "prefix_len": int(prefix_len)})
             else:
                 fn = self._suffix_prefill_fn(bucket)
                 with annotate("serving.restore_prefix"):

@@ -36,9 +36,13 @@ harness, and telemetry already speak:
   lazily build a parent-side reference engine with identical weights —
   weights never cross the pipe).
 
-The per-child prefix cache is internal to the child and not parent-visible
-(``scheduler.prefix_cache`` reads ``None``), so chaos ``when=restore`` remains
-an in-process-replica trigger.
+The per-child prefix cache is internal to the child — the parent never holds
+a trie handle (``scheduler.prefix_cache`` reads ``None``), so chaos
+``when=restore`` remains an in-process-replica trigger. What DOES cross the
+pipe (PR 19, additive heartbeat field ``cache``) is the cache's gossip: hit
+economics, tiered-cache byte/movement counters, and the digest ladder of
+resident prefixes, which the router's prefix-aware dispatch scores with
+:func:`~.prefix_cache.match_from_digests` instead of a probe round trip.
 
 Threading: like the router — drive :meth:`ReplicaSupervisor.step` from the
 same loop as ``router.step()`` (``deepspeed-serve --host-replicas`` and the
@@ -101,6 +105,8 @@ class HostConfig:
     # its cache/pool and reports hit-rate economics in its heartbeat
     prefix_cache: bool = False
     prefix_cache_mb: Optional[float] = None
+    prefix_tier_mb: Optional[float] = None   # host-RAM rung under the HBM
+    #   budget (PR 19): evicted device entries spill here and promote back
     prefix_min_hit: Optional[int] = None
     kv_pool: Optional[str] = None      # paged | slots (child default: paged)
     kv_page_size: Optional[int] = None
@@ -117,6 +123,7 @@ class HostConfig:
              "slots": self.slots, "chunk_size": self.chunk_size,
              "hb_interval": self.hb_interval_s}
         for key, val in (("prefix_cache_mb", self.prefix_cache_mb),
+                         ("prefix_tier_mb", self.prefix_tier_mb),
                          ("prefix_min_hit", self.prefix_min_hit),
                          ("kv_pool", self.kv_pool),
                          ("kv_page_size", self.kv_page_size),
@@ -292,8 +299,19 @@ class _HostSchedulerView:
     def prefix_cache_report(self) -> Dict:
         hb = self._host.hb
         if hb is not None and hb.get("prefix_hit_rate") is not None:
-            return {"enabled": True, "child": True,
-                    "hit_rate": float(hb["prefix_hit_rate"])}
+            rep = {"enabled": True, "child": True,
+                   "hit_rate": float(hb["prefix_hit_rate"])}
+            # PR 19 gossip: the child's KV economy rides the heartbeat so the
+            # router's fleet aggregation covers hosted replicas too (stale hb
+            # → stale numbers, never an error)
+            cache = hb.get("cache")
+            if isinstance(cache, dict):
+                for key in ("hits", "misses", "hit_tokens", "cached_bytes",
+                            "spilled_bytes", "spills", "promotions",
+                            "entries", "host_entries"):
+                    if key in cache:
+                        rep[key] = cache[key]
+            return rep
         return {"enabled": False}
 
 

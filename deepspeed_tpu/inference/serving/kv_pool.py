@@ -466,6 +466,22 @@ class PagedKVPool:
         return _paged_gather_jit(R)(self.caches,
                                     jnp.asarray(self.page_table[slot]))
 
+    def gather_pages(self, pages, rows: int) -> List[Dict[str, Any]]:
+        """Dense slab from an ARBITRARY page-index vector — ``gather_prefix``
+        without a slot, for pages a live table no longer (or never) maps:
+        the prefix cache's spill path gathers an evicted entry's pages into
+        a host-tier slab right before dropping its refcounts. Same compiled
+        mover as ``gather_prefix`` (the jit is keyed only on ``rows``), NOT
+        donated — the pool keeps serving."""
+        R = int(rows)
+        if not 0 < R <= self.cap:
+            raise ValueError(f"rows must be in [1, cap={self.cap}], got {R}")
+        n = self.pages_for(R)
+        if n > len(pages):
+            raise ValueError(f"{R} rows span {n} pages, got {len(pages)}")
+        tbl = jnp.asarray(np.asarray(pages, np.int32)[:n])
+        return _paged_gather_jit(R)(self.caches, tbl)
+
     def restore_prefix(self, slot: int, slab: List[Dict[str, Any]]) -> None:
         """Write a dense gathered slab into rows ``[0, slab_rows)`` of the
         slot's pages (donated pool update). Assumes a freshly acquired slot:
@@ -478,6 +494,42 @@ class PagedKVPool:
         if n > int(self._slot_npages[slot]):
             raise ValueError(f"slot {slot} holds {self._slot_npages[slot]} "
                              f"pages, slab needs {n}")
+        self.caches = _paged_restore_jit(R)(
+            self.caches, slab, jnp.asarray(self.page_table[slot, :n]))
+
+    def promote_prefix(self, slot: int, slab: List[Dict[str, Any]],
+                       matched: int) -> None:
+        """Restore a host-tier slab's first ``matched`` rows into a freshly
+        acquired slot — the promote path of the tiered prefix cache. The
+        restore width is normalized HOST-SIDE to the page multiple covering
+        ``matched`` (slice or zero-pad the numpy slab), so the compiled
+        restore is keyed on page multiples only — geometry-bounded compile
+        keys instead of one per distinct spilled-prompt length. Rows in
+        ``[matched, page-multiple)`` land in the slot's own private pages and
+        are overwritten by the suffix prefill or masked by ``cache_len`` —
+        the same argument ``restore_prefix`` already makes for its padding.
+        Requires a slot acquired WITHOUT shared prefix pages (the donated
+        write would otherwise clobber rows other slots still trust)."""
+        m = int(matched)
+        rows = int(slab[0]["k"].shape[1])
+        if not 0 < m <= min(rows, self.cap):
+            raise ValueError(f"matched must be in [1, min(slab rows {rows}, "
+                             f"cap {self.cap})], got {m}")
+        n = self.pages_for(m)
+        if n > int(self._slot_npages[slot]):
+            raise ValueError(f"slot {slot} holds {self._slot_npages[slot]} "
+                             f"pages, promote needs {n}")
+        R = n * self.page_size
+        if rows != R:
+            fixed = []
+            for s in slab:
+                k = np.asarray(s["k"])[:, :R, :]
+                v = np.asarray(s["v"])[:, :R, :]
+                if k.shape[1] < R:
+                    pad = ((0, 0), (0, R - k.shape[1]), (0, 0))
+                    k, v = np.pad(k, pad), np.pad(v, pad)
+                fixed.append({"k": k, "v": v})
+            slab = fixed
         self.caches = _paged_restore_jit(R)(
             self.caches, slab, jnp.asarray(self.page_table[slot, :n]))
 

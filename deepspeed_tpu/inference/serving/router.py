@@ -75,6 +75,7 @@ from ...observability.metrics import Histogram, RegistryFeed
 from ...observability.trace import CAT_ROUTER, get_tracer
 from ...utils.fault_injection import fault_point, retry_with_backoff
 from ...utils.logging import logger
+from .prefix_cache import match_from_digests
 from .scheduler import (ContinuousBatchingScheduler, QueueFullError,
                         RequestState, ServingConfig, validate_admission)
 from .telemetry import adaptive_retry_after
@@ -186,6 +187,15 @@ class RouterConfig:
     #   fit inside shed_margin * deadline (shed earlier under pressure)
     retire_grace_s: float = 5.0          # scale-down: in-flight drain window
     #   before the remainder migrates with prefixes (begin_retire default)
+    # --- prefix-aware dispatch (fleet KV economy) ---
+    prefix_aware_routing: bool = False   # score replicas by expected
+    #   prefill-tokens-saved (in-process peek / hosted heartbeat gossip)
+    #   against outstanding load; session affinity demotes from the only
+    #   locality signal to a score tiebreaker. Off = legacy affinity-pin +
+    #   least-outstanding.
+    prefix_route_load_weight: float = 32.0   # dispatch score is
+    #   saved_tokens - weight * outstanding: one queued/running request
+    #   outweighs this many reusable prefix tokens
     serving: ServingConfig = field(default_factory=ServingConfig)  # per replica
 
 
@@ -211,6 +221,8 @@ class RouterRequest:
     evictions: int = 0
     prefix_hit_tokens: int = 0        # from the attempt that produced the
     #   first token (the one TTFT measures) — loadgen splits TTFT on this
+    expected_saved_tokens: int = 0    # prefix-aware dispatch: the winner's
+    #   predicted prefill-tokens-saved at pick time (telemetry only)
     excluded: Set[int] = field(default_factory=set)   # replica exclusion list
     replica_id: Optional[int] = None
     inner: Optional[object] = None    # current attempt's RequestHandle
@@ -395,6 +407,10 @@ class RouterTelemetry:
         self.shed = 0                     # refused at admission: infeasible SLO
         self.deferred = 0                 # refused at admission: low priority
         self.dispatched: Dict[int, int] = {i: 0 for i in range(n_replicas)}
+        # prefix-aware dispatch accounting: dispatches won on a non-zero
+        # expected-saved score, and the cumulative predicted tokens saved
+        self.prefix_routed = 0
+        self.prefix_saved_tokens = 0
         self.transitions: List = []       # (tick, replica, old, new)
         # bounded distributions (same O(1)-memory contract as ServingTelemetry)
         self.ttft_ms = Histogram()
@@ -413,7 +429,7 @@ class RouterTelemetry:
             self.monitor.write_events(events)
 
     def on_step(self, queue_depth: int, replicas, health,
-                rung: int = 0) -> None:
+                rung: int = 0, kv_economy=None) -> None:
         self._tick += 1
         live = sum(1 for r in replicas
                    if health[r.id].state != ReplicaState.DEAD)
@@ -435,6 +451,13 @@ class RouterTelemetry:
             if r.scheduler.prefix_cache is not None:
                 ev.append((f"router/replica{r.id}/prefix_hit_rate",
                            float(r.scheduler.prefix_hit_rate), self._tick))
+        if kv_economy is not None:
+            ev += [("router/fleet_prefix_hit_rate",
+                    float(kv_economy["fleet_hit_rate"]), self._tick),
+                   ("router/prefix_routed_total",
+                    float(self.prefix_routed), self._tick),
+                   ("router/prefix_saved_tokens_total",
+                    float(self.prefix_saved_tokens), self._tick)]
         self._write(ev)
 
     def on_transition(self, replica_id: int, old: ReplicaState,
@@ -445,6 +468,11 @@ class RouterTelemetry:
 
     def on_dispatch(self, replica_id: int) -> None:
         self.dispatched[replica_id] = self.dispatched.get(replica_id, 0) + 1
+
+    def on_prefix_route(self, saved_tokens: int) -> None:
+        """A dispatch won on a non-zero expected-prefix-saved score."""
+        self.prefix_routed += 1
+        self.prefix_saved_tokens += int(saved_tokens)
 
     def on_rejected(self) -> None:
         self.rejected += 1
@@ -717,8 +745,9 @@ class Router:
         self._harvest(now)
         self._retire_sweep(now)
         self._update_rung()
+        kv = self.kv_economy_report() if self._kv_economy_enabled() else None
         self.telemetry.on_step(len(self.queue), self.replicas, self.health,
-                               rung=self._rung.value)
+                               rung=self._rung.value, kv_economy=kv)
 
     def run(self, max_steps: int = 100000) -> Dict:
         """Drive ``step()`` until every admitted request reaches a terminal
@@ -738,8 +767,9 @@ class Router:
         snap["replicas"] = len(self.replicas)
         snap["retired_replicas"] = list(self.retired)
         snap["degradation_rung"] = self._rung.value
-        if any(r.scheduler.prefix_cache is not None for r in self.replicas):
+        if self._kv_economy_enabled():
             snap["prefix_cache"] = self.prefix_cache_report()
+            snap["kv_economy"] = self.kv_economy_report()
         return snap
 
     def prefix_cache_report(self) -> Dict:
@@ -756,7 +786,77 @@ class Router:
             "hit_tokens": sum(p.get("hit_tokens", 0) for p in per.values()),
             "cached_bytes": sum(p.get("cached_bytes", 0)
                                 for p in per.values()),
+            "spilled_bytes": sum(p.get("spilled_bytes", 0)
+                                 for p in per.values()),
+            "spills": sum(p.get("spills", 0) for p in per.values()),
+            "promotions": sum(p.get("promotions", 0) for p in per.values()),
             **per,
+        }
+
+    def _kv_economy_enabled(self) -> bool:
+        """Any replica with a prefix cache — in-process (direct trie access)
+        or hosted (child-side cache gossiped over the heartbeat)."""
+        for r in self.replicas:
+            if getattr(r.scheduler, "prefix_cache", None) is not None:
+                return True
+            hb = getattr(r, "hb", None)
+            if isinstance(hb, dict) and isinstance(hb.get("cache"), dict):
+                return True
+        return False
+
+    def kv_economy_report(self) -> Dict:
+        """Fleet-wide KV economy: admission-level hit accounting plus the
+        tiered-cache byte/movement counters, aggregated across in-process
+        replicas (scheduler telemetry + trie stats) and hosted replicas
+        (heartbeat-gossiped ``cache`` dict — stale gossip degrades the
+        numbers, never correctness)."""
+        hits = misses = hit_tokens = 0
+        cached = spilled = spills = promotions = 0
+        per = {}
+        for r in self.replicas:
+            pc = getattr(r.scheduler, "prefix_cache", None)
+            if pc is not None:
+                t = r.scheduler.telemetry
+                s = pc.stats()
+                row = {"hits": t.prefix_hits, "misses": t.prefix_misses,
+                       "hit_tokens": t.prefix_hit_tokens,
+                       "cached_bytes": s["cached_bytes"],
+                       "spilled_bytes": s["spilled_bytes"],
+                       "spills": s["spills"],
+                       "promotions": s["promotions"]}
+            else:
+                hb = getattr(r, "hb", None)
+                cache = hb.get("cache") if isinstance(hb, dict) else None
+                if not isinstance(cache, dict):
+                    continue
+                row = {"hits": int(cache.get("hits", 0)),
+                       "misses": int(cache.get("misses", 0)),
+                       "hit_tokens": int(cache.get("hit_tokens", 0)),
+                       "cached_bytes": int(cache.get("cached_bytes", 0)),
+                       "spilled_bytes": int(cache.get("spilled_bytes", 0)),
+                       "spills": int(cache.get("spills", 0)),
+                       "promotions": int(cache.get("promotions", 0))}
+            hits += row["hits"]
+            misses += row["misses"]
+            hit_tokens += row["hit_tokens"]
+            cached += row["cached_bytes"]
+            spilled += row["spilled_bytes"]
+            spills += row["spills"]
+            promotions += row["promotions"]
+            per[f"replica{r.id}"] = row
+        n = hits + misses
+        return {
+            "enabled": bool(per),
+            "fleet_hit_rate": hits / n if n else 0.0,
+            "hits": hits, "misses": misses,
+            "prefill_tokens_skipped": hit_tokens,
+            "cached_bytes": cached,
+            "spilled_bytes": spilled,
+            "spills_total": spills,
+            "promotions_total": promotions,
+            "prefix_routed": self.telemetry.prefix_routed,
+            "prefix_saved_tokens": self.telemetry.prefix_saved_tokens,
+            "per_replica": per,
         }
 
     # ------------------------------------------------------------------- drain
@@ -1069,18 +1169,58 @@ class Router:
             return h.probe_request is None and replica.available > 0
         return False
 
+    def _expected_saved(self, replica: EngineReplica,
+                        prompt: np.ndarray) -> int:
+        """Expected prefill-tokens-saved if ``prompt`` lands on ``replica``.
+
+        In-process replicas answer exactly via a read-only trie probe
+        (``PrefixCache.peek`` — device or host rung, either avoids the
+        re-prefill). Hosted replicas answer approximately from the digest
+        ladder gossiped on their last heartbeat; a stale or absent heartbeat
+        degrades to 0 (routing quality, never correctness)."""
+        pc = getattr(replica.scheduler, "prefix_cache", None)
+        if pc is not None:
+            try:
+                return int(pc.peek(prompt)[0])
+            except Exception:
+                return 0
+        hb = getattr(replica, "hb", None)
+        if not isinstance(hb, dict):
+            return 0
+        cache = hb.get("cache")
+        if not isinstance(cache, dict):
+            return 0
+        return match_from_digests(prompt, cache.get("digests"))
+
     def _pick(self, rr: RouterRequest) -> Optional[EngineReplica]:
         cands = [r for r in self.replicas if self._usable(r, rr)]
         if not cands:
             return None
         non_excluded = [r for r in cands if r.id not in rr.excluded]
         pool = non_excluded or cands       # all excluded → retry anywhere sane
-        if rr.session is not None:
-            pinned = self._affinity.get(rr.session)
+        pinned = self._affinity.get(rr.session) if rr.session is not None \
+            else None
+        if not self.config.prefix_aware_routing:
             for r in pool:
                 if r.id == pinned:
                     return r
-        return min(pool, key=lambda r: (r.outstanding, r.id))
+            return min(pool, key=lambda r: (r.outstanding, r.id))
+        # prefix-aware dispatch: score = expected prefill-tokens-saved minus
+        # load penalty; session affinity is only a tiebreaker. Retries fold
+        # accumulated tokens so the probe sees what prefill will see.
+        prompt = np.concatenate(
+            [rr.prompt, np.asarray(rr.tokens, np.int32)]) \
+            if rr.tokens else rr.prompt
+        w = self.config.prefix_route_load_weight
+        best, best_key, best_saved = None, None, 0
+        for r in pool:
+            saved = self._expected_saved(r, prompt)
+            key = (saved - w * r.outstanding,
+                   1 if r.id == pinned else 0, -r.id)
+            if best_key is None or key > best_key:
+                best, best_key, best_saved = r, key, saved
+        rr.expected_saved_tokens = best_saved
+        return best
 
     def _dispatch(self, now: float) -> None:
         cfg = self.config
@@ -1149,6 +1289,9 @@ class Router:
             if h.state == ReplicaState.RECOVERING:
                 h.probe_request = rr.id
             self.telemetry.on_dispatch(target.id)
+            if self.config.prefix_aware_routing \
+                    and rr.expected_saved_tokens > 0:
+                self.telemetry.on_prefix_route(rr.expected_saved_tokens)
 
     # -------------------------------------------------------------------- pump
     def _pump(self, now: float) -> None:

@@ -205,6 +205,11 @@ class ContinuousBatchingScheduler:
                 # pages always belong to the pool this resolves to)
                 self.prefix_cache.page_release = \
                     lambda pages: self.executor.pool.release_shared(pages)
+                # spill path: gather an evicted entry's pages as a dense host
+                # slab (the gather_prefix wire format) before the refs drop
+                self.prefix_cache.page_gather = \
+                    lambda pages, rows: self.executor.pool.gather_pages(
+                        pages, rows)
         self.queue: Deque[RequestHandle] = deque()
         self._ids = itertools.count()
         S = cfg.slots
@@ -373,11 +378,14 @@ class ContinuousBatchingScheduler:
     def _rebuild_pool(self) -> None:
         """Discard + rebuild the KV pool after a failure that may have
         consumed donated buffers. On the paged pool the prefix cache's shared
-        pages live INSIDE the discarded buffers, so its entries are cleared
-        with it — the honest cost of zero-copy sharing (slab-mode entries are
-        independent gathered copies and survive, as before)."""
+        pages live INSIDE the discarded buffers, so its device rung is
+        dropped with it (without spilling — gathering from a poisoned pool is
+        not trustworthy) — the honest cost of zero-copy sharing. Host-rung
+        entries are independent numpy slabs and survive to serve promote hits
+        against the rebuilt pool, exactly like slot-mode's independent
+        gathered slabs always have."""
         if self.executor.paged and self.prefix_cache is not None:
-            self.prefix_cache.clear()
+            self.prefix_cache.drop_device()
         self.executor.reset_pool()
 
     # --------------------------------------------------------------- eviction
@@ -482,8 +490,13 @@ class ContinuousBatchingScheduler:
                 if pool.paged and self.prefix_cache is not None \
                         and pool.free_slots > 0:
                     matched_hint, keep = self.prefix_cache.peek(head.prompt)
-                    frees = lambda e: e is not keep and any(  # noqa: E731
-                        pool.page_ref(p) == 1 for p in e.pages)
+                    if keep is not None and keep.pages is None:
+                        # host-rung match: the promote path acquires all-fresh
+                        # pages, so the hint must not shrink the page need
+                        matched_hint = 0
+                    frees = lambda e: e is not keep and \
+                        e.pages is not None and any(  # noqa: E731
+                            pool.page_ref(p) == 1 for p in e.pages)
                     while not pool.can_admit(need_tokens,
                                              matched=matched_hint) and \
                             self.prefix_cache.evict_lru(frees):
@@ -502,13 +515,16 @@ class ContinuousBatchingScheduler:
                                    time.monotonic(),
                                    attrs={"hit": entry is not None,
                                           "matched_tokens": int(matched)})
-            if pool.paged and entry is not None:
+            if pool.paged and entry is not None and entry.pages is not None:
                 # zero-copy hit: bind the shared prefix pages into the fresh
                 # slot's table (refcount bump + one COW boundary page) — the
                 # paged replacement for the slab restore scatter
                 slot = pool.acquire(need_tokens, prefix_pages=entry.pages,
                                     matched=matched)
             else:
+                # miss, slot-pool hit, or host-rung PROMOTE hit (entry with a
+                # spilled numpy slab): all-fresh pages; the promote restores
+                # the slab into them inside prefill_into_slot
                 slot = pool.acquire(need_tokens)
             if slot is None:   # can_admit is conservative, so only a racing
                 self.queue.appendleft(handle)          # caller could land here

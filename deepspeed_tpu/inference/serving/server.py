@@ -165,6 +165,20 @@ def make_status_provider(front, autoscaler=None, recorder=None,
                    for r in front.replicas):
                 rep = front.prefix_cache_report()
                 doc["prefix_hit_rate"] = rep.get("hit_rate")
+            # fleet KV economy (PR 19): admission-level hit rate + tiered
+            # byte/movement counters across in-process AND hosted replicas
+            # (hosted numbers come from heartbeat gossip)
+            if front._kv_economy_enabled():
+                kv = front.kv_economy_report()
+                doc["kv_economy"] = {
+                    "fleet_hit_rate": kv["fleet_hit_rate"],
+                    "prefill_tokens_skipped": kv["prefill_tokens_skipped"],
+                    "cached_bytes": kv["cached_bytes"],
+                    "spilled_bytes": kv["spilled_bytes"],
+                    "spills_total": kv["spills_total"],
+                    "promotions_total": kv["promotions_total"],
+                    "prefix_routed": kv["prefix_routed"],
+                    "prefix_saved_tokens": kv["prefix_saved_tokens"]}
             specs = [r.scheduler.telemetry.spec for r in front.replicas
                      if getattr(r.scheduler.telemetry, "spec_enabled", False)]
             if specs:
@@ -503,8 +517,18 @@ def main(argv=None) -> int:
                          "bit-identical to cache-off)")
     ap.add_argument("--prefix-cache-mb", type=float, default=256.0,
                     help="prefix-cache HBM byte budget (MiB)")
+    ap.add_argument("--prefix-tier-mb", type=float, default=0.0,
+                    help="host-RAM rung under the HBM budget (MiB, 0 = off): "
+                         "LRU-evicted prefix entries spill here as dense "
+                         "slabs and promote back on a later hit (a slab "
+                         "copy instead of a re-prefill)")
     ap.add_argument("--prefix-min-hit", type=int, default=8,
                     help="minimum matched tokens for a cache hit")
+    ap.add_argument("--prefix-aware-routing", action="store_true",
+                    help="score dispatch by expected prefill-tokens-saved "
+                         "(in-process trie probe / hosted heartbeat digest "
+                         "gossip) against outstanding load; session affinity "
+                         "demotes to a tiebreaker")
     ap.add_argument("--jsonl-metrics", default=None,
                     help="directory for the jsonl monitor backend")
     ap.add_argument("--metrics-port", type=int, default=None,
@@ -600,6 +624,7 @@ def main(argv=None) -> int:
     if args.prefix_cache:
         prefix_cfg = PrefixCacheConfig(
             max_bytes=int(args.prefix_cache_mb * 1024 * 1024),
+            host_tier_bytes=int(args.prefix_tier_mb * 1024 * 1024),
             min_hit_tokens=args.prefix_min_hit,
             min_insert_tokens=args.prefix_min_hit)
     if args.kv_pool == "paged" and (
@@ -641,7 +666,8 @@ def main(argv=None) -> int:
         n0 = (max(args.min_replicas, args.replicas) if args.autoscale
               else args.replicas)
         rcfg = RouterConfig(serving=serving_cfg, max_queue=args.max_queue,
-                            slo_admission=args.slo_admission)
+                            slo_admission=args.slo_admission,
+                            prefix_aware_routing=args.prefix_aware_routing)
         if args.host_replicas or args.replica_endpoint:
             from .host import (HostConfig, HostedReplica, ReplicaSupervisor,
                                SocketHostedReplica, SupervisorConfig)
@@ -663,6 +689,9 @@ def main(argv=None) -> int:
                 prefix_cache=args.prefix_cache,
                 prefix_cache_mb=(args.prefix_cache_mb
                                  if args.prefix_cache else None),
+                prefix_tier_mb=(args.prefix_tier_mb
+                                if args.prefix_cache and args.prefix_tier_mb
+                                else None),
                 prefix_min_hit=(args.prefix_min_hit
                                 if args.prefix_cache else None),
                 kv_pool=args.kv_pool, kv_page_size=args.kv_page_size,

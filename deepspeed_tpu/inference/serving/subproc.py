@@ -92,6 +92,32 @@ def _rss_bytes() -> int:
         return 0
 
 
+def _cache_gossip(sched) -> dict:
+    """Additive heartbeat payload: the child cache's KV economy + the digest
+    ladder of its resident prefixes, so the parent's prefix-aware dispatch can
+    score this replica without a round trip. ``{}`` when the cache is off
+    (field absent keeps pre-PR-19 heartbeats byte-compatible)."""
+    pc = sched.prefix_cache
+    if pc is None:
+        return {}
+    try:
+        t = sched.telemetry
+        s = pc.stats()
+        return {"cache": {
+            "hits": int(t.prefix_hits), "misses": int(t.prefix_misses),
+            "hit_tokens": int(t.prefix_hit_tokens),
+            "cached_bytes": int(s["cached_bytes"]),
+            "spilled_bytes": int(s["spilled_bytes"]),
+            "spills": int(s["spills"]),
+            "promotions": int(s["promotions"]),
+            "entries": int(s["entries"]),
+            "host_entries": int(s["host_entries"]),
+            "digests": pc.digest_report(),
+        }}
+    except Exception:
+        return {}                   # gossip is best-effort; hb must not die
+
+
 def child_main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     ap = argparse.ArgumentParser(prog="serving.subproc")
@@ -108,6 +134,7 @@ def child_main(argv=None) -> int:
     # per-child serving knobs (HostConfig ships these across the spawn —
     # PR 16: parent flags now DO cross the pipe instead of being refused)
     ap.add_argument("--prefix-cache-mb", type=float, default=None)
+    ap.add_argument("--prefix-tier-mb", type=float, default=None)
     ap.add_argument("--prefix-min-hit", type=int, default=4)
     ap.add_argument("--kv-pool", default="paged", choices=("paged", "slots"))
     ap.add_argument("--kv-page-size", type=int, default=None)
@@ -159,6 +186,8 @@ def child_main(argv=None) -> int:
             min_insert_tokens=args.prefix_min_hit, insert_on="prefill")
         if args.prefix_cache_mb is not None:
             prefix.max_bytes = int(args.prefix_cache_mb * 1024 * 1024)
+        if args.prefix_tier_mb is not None:
+            prefix.host_tier_bytes = int(args.prefix_tier_mb * 1024 * 1024)
     page_kw = ({"kv_page_size": args.kv_page_size}
                if args.kv_page_size is not None else {})
     sched = ContinuousBatchingScheduler(engine, ServingConfig(
@@ -209,7 +238,12 @@ def child_main(argv=None) -> int:
                       # (None = cache disabled in this child)
                       "prefix_hit_rate": (float(sched.prefix_hit_rate)
                                           if sched.prefix_cache is not None
-                                          else None)})
+                                          else None),
+                      # additive v1 field (PR 19): cache gossip for
+                      # prefix-aware routing + the fleet KV-economy rollup.
+                      # Old parents ignore unknown hb fields; absent on
+                      # cache-less children
+                      **_cache_gossip(sched)})
             except (BrokenPipeError, ValueError, OSError):
                 return              # parent went away: nothing to report to
             hb_stop.wait(args.hb_interval)

@@ -15,6 +15,9 @@ parentheses):
   ``serving/prefix_evicted_total`` — per scheduler step, prefix cache enabled
   only (hit/miss/inserted/evicted counters + cached-token bytes ride the
   aggregate snapshot);
+- ``serving/prefix_spilled_bytes``, ``serving/prefix_spills_total``,
+  ``serving/prefix_promotions_total`` — per scheduler step, tiered prefix
+  cache (host-RAM rung) enabled only;
 - ``serving/spec_*`` — per verify round, speculation enabled only; the
   emission site lives in ``inference.speculative.emit_spec_events`` (the
   subsystem that owns the semantics), this class only keeps the counters.
@@ -141,6 +144,15 @@ class ServingTelemetry:
                     float(prefix_stats["cached_bytes"]), self._tick),
                    ("serving/prefix_evicted_total",
                     float(prefix_stats["evicted"]), self._tick)]
+            if "spilled_bytes" in prefix_stats:
+                # tiered-cache rung (PR 19): host-RAM residency + the two
+                # movement counters (device→host spill, host→device promote)
+                ev += [("serving/prefix_spilled_bytes",
+                        float(prefix_stats["spilled_bytes"]), self._tick),
+                       ("serving/prefix_spills_total",
+                        float(prefix_stats["spills"]), self._tick),
+                       ("serving/prefix_promotions_total",
+                        float(prefix_stats["promotions"]), self._tick)]
         self._write(ev)
 
     def on_prefix(self, hit: bool, tokens: int, enabled: bool = True) -> None:
@@ -228,6 +240,12 @@ class ServingTelemetry:
                 prefix["prefix_evicted"] = self._prefix_stats["evicted"]
                 prefix["prefix_cached_bytes"] = \
                     self._prefix_stats["cached_bytes"]
+                if "spilled_bytes" in self._prefix_stats:
+                    prefix["prefix_spilled_bytes"] = \
+                        self._prefix_stats["spilled_bytes"]
+                    prefix["prefix_spills"] = self._prefix_stats["spills"]
+                    prefix["prefix_promotions"] = \
+                        self._prefix_stats["promotions"]
         paged = ({f"paged_{k}": v for k, v in self._paged_stats.items()}
                  if self._paged_stats is not None else {})
         spec = self.spec.snapshot() if self.spec_enabled else {}

@@ -36,6 +36,14 @@ TAGS: Dict[str, Tuple[str, str]] = {
     "serving/prefix_hit_rate": (GAUGE, "admission-level prefix-cache hit rate"),
     "serving/prefix_cached_bytes": (GAUGE, "resident prefix-slab bytes"),
     "serving/prefix_evicted_total": (COUNTER, "prefix-cache LRU evictions"),
+    # ---------------------------------------- tiered prefix cache (PR 19)
+    "serving/prefix_spilled_bytes": (GAUGE, "host-RAM rung residency: bytes "
+                                            "of spilled prefix slabs"),
+    "serving/prefix_spills_total": (COUNTER, "device->host spills at LRU "
+                                             "eviction"),
+    "serving/prefix_promotions_total": (COUNTER, "host->device promotes at "
+                                                 "lookup (slab copy instead "
+                                                 "of re-prefill)"),
     # ------------------------------------------------- paged KV pool (PR 13)
     "serving/pages_in_use": (GAUGE, "allocated KV pages per scheduler tick"),
     "serving/page_fragmentation": (GAUGE, "allocation-granularity waste: "
@@ -66,6 +74,16 @@ TAGS: Dict[str, Tuple[str, str]] = {
     "router/replica{i}/health": (GAUGE, "replica state code (0 live .. 4 retiring)"),
     "router/replica{i}/outstanding": (GAUGE, "running + queued at the replica"),
     "router/replica{i}/prefix_hit_rate": (GAUGE, "per-replica prefix hit rate"),
+    # --------------------------------------- fleet KV economy (PR 19)
+    "router/fleet_prefix_hit_rate": (GAUGE, "admission-level hit rate summed "
+                                            "across all replicas (in-process "
+                                            "counters + hosted heartbeat "
+                                            "gossip)"),
+    "router/prefix_routed_total": (COUNTER, "dispatches won on a non-zero "
+                                            "expected-prefix-saved score"),
+    "router/prefix_saved_tokens_total": (COUNTER, "cumulative predicted "
+                                                  "prefill tokens saved by "
+                                                  "prefix-aware dispatch"),
     # --------------------------------------------- elastic control plane (PR 12)
     "router/live_replicas": (GAUGE, "attached non-DEAD replicas per tick"),
     "router/target_replicas": (GAUGE, "autoscaler's desired replica count"),

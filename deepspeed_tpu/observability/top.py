@@ -4,8 +4,9 @@ The live status plane (``observability.metrics.start_metrics_server`` +
 ``inference/serving/server.make_status_provider``) publishes one JSON
 document; this renders it as a refreshing terminal frame — replica health and
 outstanding work, the degradation rung, paged-KV pressure, prefix hit rate,
-the last autoscale decisions, recent anomaly trips, and the flight recorder's
-retention stats. ``--once`` prints a single frame (scripts/tests);
+the fleet KV economy (hit rate, spill/promote counters, prefill tokens
+skipped), the last autoscale decisions, recent anomaly trips, and the flight
+recorder's retention stats. ``--once`` prints a single frame (scripts/tests);
 otherwise the frame redraws every ``--interval`` seconds until interrupted.
 """
 
@@ -81,7 +82,16 @@ def render(doc: Dict) -> str:
                      f"/{_fmt(p.get('total_pages'), 0)}  "
                      f"fragmentation={_fmt(p.get('page_fragmentation'))}  "
                      f"shared={_fmt(p.get('prefix_shared_pages'), 0)}")
-    sp = doc.get("speculative")
+    kv = doc.get("kv_economy")
+    if kv:
+        lines.append(
+            f"kv: fleet_hit={_fmt(kv.get('fleet_hit_rate'))}  "
+            f"prefill_skipped={_fmt(kv.get('prefill_tokens_skipped'), 0)}tok  "
+            f"spills={_fmt(kv.get('spills_total'), 0)}  "
+            f"promotes={_fmt(kv.get('promotions_total'), 0)}  "
+            f"spilled_mb={_fmt((kv.get('spilled_bytes') or 0) / 2**20, 1)}  "
+            f"routed={_fmt(kv.get('prefix_routed'), 0)}")
+    sp = doc.get("spec")
     if sp:
         lines.append(f"spec: accept={_fmt(sp.get('acceptance_rate'))}  "
                      f"accepted={_fmt(sp.get('accepted'), 0)}"

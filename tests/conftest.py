@@ -40,7 +40,12 @@ TIER1_BUDGETS_S = {
     1: ("observability", 40),      # pure-host tracing/metrics lane
     2: ("analysis", 70),           # contract passes over the real programs
     3: ("serving_family", 430),    # serving + router + prefix_cache + paged_kv
-    #     + autoscale + host + net + speculative: the compiled-dispatch block
+    #     + autoscale + host + net + speculative + prefix_tier: the
+    #     compiled-dispatch block. PR 19's tiered-cache lane
+    #     (test_prefix_tier.py, ~25 s) rides inside this share — paid for by
+    #     demoting the duplicate plain-loadgen smoke to ``slow`` (the loadgen
+    #     entry path stays covered by the slow bench smokes and the prefix/
+    #     paged lanes' in-process run_load calls).
     4: ("comm_overlap", 90),       # chunked-collective parity + bench smoke
     5: ("weight_quant", 70),       # int4/int8 pack + fused-dequant parity
     6: ("unranked", 50),           # models, runtime units, everything else

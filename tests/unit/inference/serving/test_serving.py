@@ -302,6 +302,9 @@ def test_telemetry_jsonl_events(engine, tmp_path):
 
 
 # ------------------------------------------------------------ loadgen smoke
+@pytest.mark.slow   # duplicate of the slow bench smokes' entry-path coverage;
+# demoted in PR 19 to pay for test_prefix_tier.py inside serving_family's
+# tier-1 share (tests/conftest.py TIER1_BUDGETS_S rank 3)
 def test_loadgen_smoke(capsys):
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))))
