@@ -29,6 +29,14 @@ an a2a, the monolithic-psum fallback's allreduce) never appear in the jaxpr
 — sites recorded with those ops are excluded from the exact cross-check and
 surfaced as ``info`` findings instead (documented limitation; their volume
 is checked by the bench A/B lanes, not statically).
+
+Quantized wires need no special convention: the ppermute rule sums ALL
+operand avals, so a fused-quantized-ring hop (``parallel/qring.py``) —
+one intN carrier (int4 packs two elements per int8 byte, so the aval IS the
+wire footprint) plus one fp32 scale vector per block — is accounted from
+shapes x dtypes exactly like any fp hop. :func:`qring_wire_bytes` is the
+closed form of that int-chunk arithmetic; the qring lint lane asserts the
+recorded span, this closed form, and the jaxpr sum agree to the byte.
 """
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -129,6 +137,34 @@ def collective_accounting(fn_or_jaxpr, args=()) -> List[Dict[str, Any]]:
 
     walk(jaxpr, {})
     return records
+
+
+def qring_wire_bytes(m: int, n: int, W: int, *, wire_bits: Optional[int] = 8,
+                     block: int = 256, bidirectional: bool = True) -> int:
+    """Closed-form per-worker bytes-on-wire of ONE fused quantized
+    matmul-reduce-scatter dispatch (``parallel/qring.py``) — the intN-chunk
+    wire arithmetic under this pass's ppermute convention.
+
+    ``m``: padded flattened local token count (rows entering the ring; must
+    divide by ``W``); ``n``: output features. Each serial step ppermutes one
+    ``(m/W, n_dir)`` accumulator chunk as an intN carrier + one fp32 scale
+    per ``block`` elements over the block-padded flat length
+    (``comm.compressed.intn_wire_nbytes``); bidirectional rings make
+    ``2 (W-1)`` half-width hops, unidirectional ``W-1`` full-width ones.
+    ``wire_bits=None`` models the fp32 wire (the ground-truth lane).
+
+    The qring span records this same number at trace time and the jaxpr
+    side re-derives it from the ppermute operand avals — three independent
+    computations that the lint lane and ``bench.py --qring`` require to
+    agree exactly, so bytes-on-wire claims are never hand-computed.
+    """
+    from ..comm.compressed import intn_wire_nbytes
+    m_blk = m // W
+    bidir = bidirectional and n % 2 == 0
+    n_dir = n // 2 if bidir else n
+    hop = (m_blk * n_dir * 4 if wire_bits is None
+           else intn_wire_nbytes(m_blk * n_dir, block, wire_bits))
+    return (W - 1) * (2 if bidir else 1) * hop
 
 
 def _span_delta(before: Dict[str, Dict], after: Dict[str, Dict]

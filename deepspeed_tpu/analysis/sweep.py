@@ -25,6 +25,11 @@ Runs every contract pass against the repo's *real* programs — not toys:
   jaxpr-accounted bytes-on-wire cross-checked against ``CollectiveSpans``
   (including a deliberately twice-calling trace that pins per-site
   accumulation — the PR 3 overwrite class);
+- **qring lane** — the fused quantized collective-matmul ring: intN payload
+  bytes cross-checked three ways (span == closed form == jaxpr ppermute sum),
+  the dequant-hoist structural pin (per-group scales dequant stays OUT of the
+  ring step body), EF-residual donation, and a retrace pin on a forced-fused
+  int8 tp=4 overlap engine;
 - **AST lane** — bare-assert ban, emission-tag schema, hot-path host-sync
   rule over every library file (or only changed files in ``--changed-only``
   mode).
@@ -539,6 +544,171 @@ def overlap_lane(report: Report) -> None:
                                        target=name))
 
 
+# ------------------------------------------------------------------ qring lane
+def qring_lane(report: Report) -> None:
+    """Fused-quantized-ring contracts (``parallel/qring.py``):
+
+    - **collective schema** — the intN ring payload at wire widths 8 and 4:
+      the recorded span, the closed form
+      :func:`collectives.qring_wire_bytes`, and the jaxpr ppermute-operand
+      sum must agree to the byte (bytes-on-wire claims are never
+      hand-computed);
+    - **dequant hoist** — on the XLA (unfused) ring path the per-group-scales
+      weight dequant happens once per column direction OUTSIDE the ring
+      steps. The ring is python-unrolled (no ``lax`` loop for
+      ``loop_body_findings`` to inspect), so the pin is structural: count
+      the weight-slab int8→f32 converts in the jaxpr — ``dequantize_grouped``
+      converts the 3-D ``(groups, g, n)`` regrouped slab, while the wire
+      decompress converts 2-D ``(blocks, block)`` payloads, so the two are
+      shape-distinguishable. Hoisted = one per direction; ``W`` per
+      direction = the dequant leaked into the step body;
+    - **EF-residual donation** — a caller threading the residual across
+      dispatches (the cumulative-EF regime) gets in-place buffer reuse, read
+      off the executable's ``input_output_alias`` table;
+    - **retrace** — a forced-fused int8 tp=4 overlap engine (the deployable
+      qring decode config): two identical generates mint zero new compile
+      keys on the fused ring movers.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from ..inference.config import DeepSpeedInferenceConfig
+    from ..inference.engine import InferenceEngine
+    from ..models.causal_lm import gpt2_cfg
+    from ..ops.quantizer.quant import quantize_grouped
+    from ..parallel import qring
+    from ..parallel.mesh import AXIS_TENSOR, MeshSpec, set_global_mesh
+    from ..utils.comms_logging import collective_spans
+    from ..utils.jax_compat import shard_map
+    from .collectives import crosscheck_findings, qring_wire_bytes
+    from .donation import donation_findings
+    from .jaxpr_passes import subjaxprs
+    from .retrace import CompileCacheLint
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        r = PassResult("collective_schema", "qring", checked=0)
+        r.findings.append(Finding(
+            "collective_schema", SEVERITY_ERROR, "qring",
+            f"need 4 devices for the qring lane, found {len(devices)}"))
+        report.add(r)
+        return
+    W = 4
+    mesh = MeshSpec({"tensor": W}, devices[:W])
+    m, k, n, blk = 8, 32, 12, 16
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    q, s = quantize_grouped(
+        jnp.asarray(rng.standard_normal((k, n)), jnp.float32),
+        group_size=8, bits=8)
+
+    def ring(wire_bits, site):
+        def body(xl, ql, sl):
+            out, _ = qring.fused_quant_matmul_reduce_scatter(
+                xl, ql, sl, AXIS_TENSOR, bits=8, wire_bits=wire_bits,
+                quant_block=blk, site=site)
+            return out
+        return shard_map(body, mesh=mesh.mesh, axis_names={AXIS_TENSOR},
+                         in_specs=(P(None, AXIS_TENSOR),
+                                   P(AXIS_TENSOR, None),
+                                   P(AXIS_TENSOR, None)),
+                         out_specs=P(AXIS_TENSOR, None), check_vma=False)
+
+    # wire-bytes cross-check: span == closed form == jaxpr, to the byte
+    for wb in (8, 4):
+        site = f"lint.qring_w{wb}"
+        before = collective_spans.summary().get(site, {}).get(
+            "bytes_total", 0)
+        res = crosscheck_findings(ring(wb, site), (x, q, s),
+                                  site_prefixes=("lint.",),
+                                  target=f"qring-wire{wb}")
+        recorded = collective_spans.summary().get(site, {}).get(
+            "bytes_total", 0) - before
+        closed = qring_wire_bytes(m, n, W, wire_bits=wb, block=blk,
+                                  bidirectional=True)
+        if recorded != closed:
+            res.findings.append(Finding(
+                "collective_schema", SEVERITY_ERROR, f"qring-wire{wb}",
+                f"recorded ring span {recorded} B != closed-form "
+                f"qring_wire_bytes {closed} B — the wire-bytes model and "
+                "the ring's recording drifted apart",
+                {"recorded": int(recorded), "closed_form": int(closed)}))
+        report.add(res)
+
+    # dequant-hoist pin (structural; see docstring for the shape argument)
+    def n_weight_dequants(jx) -> int:
+        cnt = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                av = getattr(eqn.invars[0], "aval", None)
+                if av is not None and av.dtype == jnp.int8 and av.ndim == 3:
+                    cnt += 1
+            for sub in subjaxprs(eqn):
+                cnt += n_weight_dequants(sub)
+        return cnt
+
+    n_deq = n_weight_dequants(jax.make_jaxpr(ring(8, None))(x, q, s).jaxpr)
+    res = PassResult("loop_invariance", "qring-dequant-hoist", checked=1)
+    if n_deq == 0:
+        res.findings.append(Finding(
+            "loop_invariance", SEVERITY_ERROR, "qring-dequant-hoist",
+            "no weight-slab int8->f32 convert in the ring trace — the "
+            "dequant-hoist pin target vanished (fused backend forced under "
+            "the lint sweep, or dequantize_grouped restructured?)"))
+    elif n_deq > 2:
+        res.findings.append(Finding(
+            "loop_invariance", SEVERITY_ERROR, "qring-dequant-hoist",
+            f"{n_deq} weight-slab dequant converts in the ring trace — "
+            "expected one per column direction (2, bidirectional): the "
+            "per-group-scales dequant leaked into the ring step body and "
+            "re-materialises the fp weight every hop",
+            {"converts": int(n_deq)}))
+    report.add(res)
+
+    # EF-residual donation: threading callers reuse the buffer in place
+    res0 = jnp.zeros((m // W * n * W,), jnp.float32)
+
+    def body_res(xl, ql, sl, rl):
+        return qring.fused_quant_matmul_reduce_scatter(
+            xl, ql, sl, AXIS_TENSOR, bits=8, wire_bits=8, quant_block=blk,
+            residual=rl)
+
+    ring_res = shard_map(body_res, mesh=mesh.mesh, axis_names={AXIS_TENSOR},
+                         in_specs=(P(None, AXIS_TENSOR), P(AXIS_TENSOR, None),
+                                   P(AXIS_TENSOR, None), P(AXIS_TENSOR)),
+                         out_specs=(P(AXIS_TENSOR, None), P(AXIS_TENSOR)),
+                         check_vma=False)
+    report.add(donation_findings(ring_res, (x, q, s, res0),
+                                 donate_argnums=(3,),
+                                 target="qring.residual"))
+
+    # forced-fused int8 tp=4 overlap engine: retrace pin on the ring movers
+    prev = os.environ.get("DS_TPU_WQ_FORCE_FUSED")
+    os.environ["DS_TPU_WQ_FORCE_FUSED"] = "1"
+    try:
+        cfg = gpt2_cfg(**_TINY, dtype=jnp.float32)
+        engine = InferenceEngine(cfg, DeepSpeedInferenceConfig(
+            dtype="float32", max_out_tokens=_CAP,
+            weight_quant={"enabled": True, "bits": 8, "group": 8},
+            tensor_parallel={"tp_size": 4},
+            comm_overlap={"enabled": True, "chunk_bits": 8,
+                          "quant_block": 16}))
+        ids = np.asarray(
+            rng.integers(0, _TINY["vocab_size"], size=(8, 8)), np.int32)
+        lint = CompileCacheLint(engine._fns, target="qring-engine")
+        engine.generate(ids, max_new_tokens=4)
+        lint.snapshot()
+        engine.generate(ids, max_new_tokens=4)
+        report.add(lint.findings())
+    finally:
+        if prev is None:
+            os.environ.pop("DS_TPU_WQ_FORCE_FUSED", None)
+        else:
+            os.environ["DS_TPU_WQ_FORCE_FUSED"] = prev
+        set_global_mesh(None)
+
+
 # ------------------------------------------------------------------ AST lane
 def ast_lane(report: Report, repo_root: str,
              paths: Optional[Sequence[str]] = None) -> None:
@@ -587,7 +757,7 @@ def run_sweep(repo_root: str, *, ast_only: bool = False,
     ast_lane(report, repo_root, paths=paths)
     if not ast_only:
         for lane in (serving_lane, paged_lane, spec_lane, kvecon_lane,
-                     train_lane, overlap_lane):
+                     train_lane, overlap_lane, qring_lane):
             try:
                 lane(report)
             except Exception as e:  # a crashed lane is a failed sweep

@@ -91,6 +91,11 @@ def build_prefill(module, dequant, overlap=None):
     ``overlap``: the owning engine's ``OverlapConfig`` — installed for the
     duration of the TRACE (``overlap_scope``) so the compiled body bakes in
     that engine's comm-overlap lowering regardless of ambient global state.
+    This is ALSO how the fused quantized ring reaches serving: with
+    weight-quant row-parallel params AND an active scope, ``quant_dense_apply``
+    routes through ``parallel/qring.py`` (intN wire, ``chunk_bits``/
+    ``quant_block`` read from this config) instead of the monolithic psum —
+    no builder below carries ring-specific code.
     """
 
     def prefill(params, ids, caches, lens0):

@@ -212,9 +212,12 @@ def quant_dense_apply(x, node, bias, dtype, *, parallel: str = "column",
 
     ``x``: (b, t, k_logical) activations ((m, k) also accepted); ``parallel``:
     "column" (qkv/fc_in — kernel sharded ``P(None, tensor)``) or "row"
-    (o_proj/fc_out — kernel sharded ``P(tensor, None)``, monolithic psum; the
-    chunked comm-overlap ring deliberately does NOT compose with the quantized
-    kernel — quantized row-parallel falls back to the monolithic collective).
+    (o_proj/fc_out — kernel sharded ``P(tensor, None)``). Row-parallel with
+    an active ``comm_overlap`` config routes through the fused quantized
+    ring (``parallel/qring.py``): dequant-GEMM per ring step, intN wire
+    payload — retiring the PR-5 "does NOT compose with the comm_overlap
+    ring" carve-out. Overlap off (or ring-ineligible shapes) keeps the
+    monolithic psum.
 
     Fused path: TPU backend (or forced), shapes tile, shards divide. Fallback:
     XLA dequant+matmul — GSPMD shards the dequant+matmul and inserts the psum,
@@ -246,7 +249,20 @@ def quant_dense_apply(x, node, bias, dtype, *, parallel: str = "column",
         use_fused = _block_config(
             b * t, k_loc, n_loc, bits, k // groups, interp) is not None
 
-    if not use_fused:
+    # row-parallel + active overlap config: the fused quantized ring replaces
+    # the monolithic psum. The ring wires fp accumulator CHUNKS (never the
+    # packed payload), so its only alignment demands are the fp ring's own
+    # (k and groups divide tp; rows pad) — it does not require the Pallas
+    # kernel to tile (the ring hoists an XLA dequant once per trace instead).
+    use_qring = False
+    cfg_ov = None
+    if parallel == "row" and tp > 1 and _tp_aligned(node, k, n, tp, "row"):
+        from ...parallel.overlap import (_overlap_dense_eligible,
+                                         get_overlap_config)
+        cfg_ov = get_overlap_config()
+        use_qring = _overlap_dense_eligible(mesh, b, t, k, cfg_ov)[0]
+
+    if not use_fused and not use_qring:
         if fused_backend_active():
             # trace-time (once per compile): the audit said quantized, but
             # this site is streaming bf16 — say so instead of silently
@@ -308,6 +324,15 @@ def quant_dense_apply(x, node, bias, dtype, *, parallel: str = "column",
             in_specs=(P(bspec, None, None), P(None, AXIS_TENSOR),
                       P(None, AXIS_TENSOR)),
             out_specs=P(bspec, None, AXIS_TENSOR), check_vma=False)(x, q, s)
+    elif use_qring:
+        # row-parallel + comm_overlap: fused quantized ring (dequant-GEMM per
+        # ring step, intN + error-feedback wire payload) + tiled all-gather —
+        # the quantized analogue of row_parallel_dense_apply's decomposed
+        # allreduce, span-recorded under the same site names
+        from ...parallel.qring import quant_row_parallel_apply
+        y = quant_row_parallel_apply(
+            x, q, s, bits=bits, dtype=dtype, mesh=mesh,
+            batch_axes=batch_axes, cfg=cfg_ov, interpret=interp, site=site)
     else:
         # row-parallel: each shard multiplies its k slice of the quantized
         # kernel (fp32 accumulation inside the kernel), then ONE monolithic

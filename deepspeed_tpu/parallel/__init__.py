@@ -25,6 +25,11 @@ from .overlap import (
     row_parallel_dense_apply,
     RowParallelDense,
 )
+from .qring import (
+    fused_quant_allgather_matmul,
+    fused_quant_matmul_reduce_scatter,
+    quant_row_parallel_apply,
+)
 from .topology import (
     ProcessTopology,
     PipeDataParallelTopology,

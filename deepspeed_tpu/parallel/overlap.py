@@ -27,9 +27,11 @@ turns them on behind the ``"comm_overlap"`` config block:
   whole).
 
 The quantized-collective half of the config block (``quantized_allreduce``,
-EQuARX-style int8 blockwise psum for DP gradient sync, arxiv 2506.17615) lives
+EQuARX-style intN blockwise psum for DP gradient sync, arxiv 2506.17615) lives
 in ``comm/compressed.py`` next to the 1-bit machinery it composes with; the
-engine consumes it directly.
+engine consumes it directly. The composition of BOTH halves — the ppermute
+ring with a quantized wire payload and a dequant-GEMM per ring step (serving
+TP decode over quantized weights) — lives in ``parallel/qring.py``.
 
 Every decomposed/monolithic call site records a trace-time bytes-on-wire span
 (``utils.comms_logging.collective_spans``) so MonitorMaster and ``bench.py
@@ -73,11 +75,15 @@ class OverlapConfig:
     - ``enabled``: master switch; everything below is inert without it.
     - ``collective_matmul``: decomposed (chunked, ppermute-ring) TP matmuls +
       chunked MoE dispatch/combine.
-    - ``quantized_allreduce``: int8 blockwise-scaled DP gradient sync with
+    - ``quantized_allreduce``: intN blockwise-scaled DP gradient sync with
       error feedback (plain-DP regime only; see ``runtime/engine.py``).
-    - ``chunk_bits``: wire width of the quantized collective (8 only).
+    - ``chunk_bits``: wire width of the quantized collectives — the intN
+      payload of the fused quantized ring (``parallel/qring.py``) and the DP
+      gradient sync. One of {4, 8, 16} (int4 nibble-packed / EQuARX int8 /
+      int16); anything else is a loud error, never a silent clamp.
     - ``bidirectional``: ring chunks travel both ICI directions.
-    - ``quant_block``: elements per absmax scale block of the quantized psum.
+    - ``quant_block``: elements per absmax scale block of the quantized
+      collectives (even, >= 8 — int4 packs two wire elements per byte).
     - ``moe_chunks``: target chunk count for the MoE a2a pipeline.
     """
     enabled: bool = False
@@ -89,13 +95,17 @@ class OverlapConfig:
     moe_chunks: int = 4
 
     def __post_init__(self):
-        if self.chunk_bits != 8:
+        from ..comm.compressed import WIRE_BITS
+        if self.chunk_bits not in WIRE_BITS:
             raise ValueError(
-                f"comm_overlap.chunk_bits={self.chunk_bits} unsupported — only "
-                "8-bit blockwise-scaled collectives are wired (EQuARX int8)")
-        if self.quant_block < 8:
+                f"comm_overlap.chunk_bits={self.chunk_bits} unsupported — the "
+                f"quantized wire is blockwise-scaled intN with N in "
+                f"{sorted(WIRE_BITS)} (int4 nibble-packed / EQuARX int8 / "
+                "int16); widths are validated, not clamped")
+        if self.quant_block < 8 or self.quant_block % 2:
             raise ValueError(
-                f"comm_overlap.quant_block={self.quant_block} too small (>= 8)")
+                f"comm_overlap.quant_block={self.quant_block} invalid "
+                "(even, >= 8)")
 
     @property
     def matmul_active(self) -> bool:
@@ -399,11 +409,12 @@ class RowParallelDense(nn.Module):
     """Drop-in for ``nn.Dense`` at row-parallel TP sites (o_proj / fc_out).
 
     At serve time the engine may replace ``kernel`` with a quant node
-    (``ops/quantizer``): the projection then runs the fused dequant-matmul
-    kernel with ONE monolithic psum — the chunked comm-overlap ring
-    deliberately does not compose with the quantized kernel (the ring would
-    re-slice the packed payload mid-group), so quantized row-parallel falls
-    back to the monolithic collective even when ``comm_overlap`` is on."""
+    (``ops/quantizer``): when ``comm_overlap`` is active the projection then
+    runs the fused quantized ring (``parallel/qring.py``) — a dequant-GEMM
+    per ring step over the shard's whole packed slab (group boundaries never
+    cross the wire, only fp accumulator chunks do), with the ring payload
+    itself quantized to ``chunk_bits``. Ineligible shapes (or overlap off)
+    keep the PR-5 fused kernel + monolithic psum."""
     features: int
     use_bias: bool = True
     dtype: Any = jnp.float32

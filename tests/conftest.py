@@ -39,16 +39,20 @@ TIER1_BUDGETS_S = {
     0: ("fault_tolerance", 120),   # subprocess SIGKILL rings + ckpt rewind
     1: ("observability", 40),      # pure-host tracing/metrics lane
     2: ("analysis", 70),           # contract passes over the real programs
-    3: ("serving_family", 430),    # serving + router + prefix_cache + paged_kv
+    3: ("serving_family", 370),    # serving + router + prefix_cache + paged_kv
     #     + autoscale + host + net + speculative + prefix_tier: the
     #     compiled-dispatch block. PR 19's tiered-cache lane
     #     (test_prefix_tier.py, ~25 s) rides inside this share — paid for by
     #     demoting the duplicate plain-loadgen smoke to ``slow`` (the loadgen
     #     entry path stays covered by the slow bench smokes and the prefix/
-    #     paged lanes' in-process run_load calls).
+    #     paged lanes' in-process run_load calls). PR 20 takes 60 s of this
+    #     share for the qring lane — the family ran ~340 s at PR-19 HEAD, so
+    #     the headroom was real, and the ring lanes are the suite's newest
+    #     unvetted compile load.
     4: ("comm_overlap", 90),       # chunked-collective parity + bench smoke
-    5: ("weight_quant", 70),       # int4/int8 pack + fused-dequant parity
-    6: ("unranked", 50),           # models, runtime units, everything else
+    5: ("qring", 60),              # fused quantized ring: parity + EF + bytes
+    6: ("weight_quant", 70),       # int4/int8 pack + fused-dequant parity
+    7: ("unranked", 50),           # models, runtime units, everything else
 }
 TIER1_WINDOW_S = 870
 
@@ -72,9 +76,11 @@ def _tier1_rank(it) -> int:
         return 3
     if it.get_closest_marker("comm_overlap") is not None:
         return 4
-    if it.get_closest_marker("weight_quant") is not None:
+    if it.get_closest_marker("qring") is not None:
         return 5
-    return 6
+    if it.get_closest_marker("weight_quant") is not None:
+        return 6
+    return 7
 
 
 def pytest_configure(config):
@@ -98,6 +104,12 @@ def pytest_configure(config):
         "markers", "comm_overlap: comm-compute overlap parity lane (chunked "
         "collective matmuls, quantized allreduce, bench --overlap smoke) — "
         "tier-1 fast lane")
+    config.addinivalue_line(
+        "markers", "qring: fused quantized collective-matmul ring lane "
+        "(fp-wire last-ulp parity vs monolithic psum, intN wire error "
+        "bounds, EF-across-ring-steps convergence, overflow gate, "
+        "chunk_bits sweep + byte crosscheck) — tier-1 fast lane; its "
+        "bench --qring smoke is marked slow")
     config.addinivalue_line(
         "markers", "weight_quant: weight-streaming quantized decode lane "
         "(int4 packing, fused dequant-matmul parity, audit, bench --wq "
@@ -153,7 +165,7 @@ def pytest_collection_modifyitems(config, items):
     'tests/unit/ops/test_weight_quant'). Run lanes in ``_tier1_rank`` order
     (budgets: ``TIER1_BUDGETS_S``); relative order within a rank is
     unchanged."""
-    if any(_tier1_rank(it) < 6 for it in items):
+    if any(_tier1_rank(it) < 7 for it in items):
         items.sort(key=_tier1_rank)  # stable: preserves order within a rank
 
 

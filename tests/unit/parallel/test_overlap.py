@@ -285,8 +285,16 @@ def test_quantized_dp_regime_gate(eight_devices):
 
 
 def test_overlap_config_validation():
+    # chunk_bits lifted to {4, 8, 16} in PR 20 (the qring wire widths);
+    # anything else is a validation ERROR, not a silent clamp
+    for ok in (4, 8, 16):
+        assert ov.OverlapConfig(chunk_bits=ok).chunk_bits == ok
     with pytest.raises(ValueError, match="chunk_bits"):
-        ov.OverlapConfig(chunk_bits=4)
+        ov.OverlapConfig(chunk_bits=5)
+    with pytest.raises(ValueError, match="chunk_bits"):
+        ov.OverlapConfig(chunk_bits=32)
+    with pytest.raises(ValueError, match="quant_block"):
+        ov.OverlapConfig(quant_block=7)
     with pytest.raises(ValueError, match="unknown comm_overlap keys"):
         ov.resolve_overlap_config({"enabled": True, "chunk_size": 2})
     cfg = ov.resolve_overlap_config({"enabled": True, "bidirectional": False})
